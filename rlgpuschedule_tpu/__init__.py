@@ -1,0 +1,22 @@
+"""rlgpuschedule_tpu — a TPU-native RL GPU-cluster scheduler framework.
+
+A from-scratch rebuild of the capabilities of ``matthewygf/RLGPUSchedule``
+(see SURVEY.md; the reference mount was empty, so parity targets come from the
+driver's capability spec, provenance tag ``[B]`` in SURVEY.md):
+
+- L0 traces:      Microsoft Philly / Alibaba PAI loaders + synthetic Poisson.
+- L1 simulator:   a discrete-event GPU-cluster simulator, twice —
+                  * ``sim.oracle``: an exact event-driven Python oracle
+                    (executable spec, hosts the baseline schedulers), and
+                  * ``sim.core``:   a pure-functional, jit/vmap-able JAX sim
+                    with fixed-shape state (the TPU-native hot path).
+- L2 env:         gym-style pure-functional env with grid / flat / graph
+                  observations, JCT + fairness rewards, action masking.
+- L3 models:      Flax MLP / CNN / GNN actor-critic encoders.
+- L4 algorithms:  PPO / A2C with fused lax.scan rollouts and reverse-scan GAE.
+- L5 parallel:    data-parallel shard_map + psum over a device mesh,
+                  hierarchical multi-agent, population-based training.
+- L6 driver:      named configs, train/evaluate CLIs, metrics, checkpoints.
+"""
+
+__version__ = "0.1.0"

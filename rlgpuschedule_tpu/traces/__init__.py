@@ -1,0 +1,13 @@
+"""L0 trace layer: normalized job records, loaders, synthetic generator."""
+from .records import (JobRecord, ArrayTrace, to_array_trace, from_array_trace,
+                      STATUS_PASS, STATUS_KILLED, STATUS_FAILED)
+from .synthetic import gen_poisson_jobs, gen_poisson_trace
+from .philly import load_philly, load_philly_jobs
+from .pai import load_pai, load_pai_jobs
+
+__all__ = [
+    "JobRecord", "ArrayTrace", "to_array_trace", "from_array_trace",
+    "STATUS_PASS", "STATUS_KILLED", "STATUS_FAILED",
+    "gen_poisson_jobs", "gen_poisson_trace",
+    "load_philly", "load_philly_jobs", "load_pai", "load_pai_jobs",
+]

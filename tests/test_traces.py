@@ -1,0 +1,98 @@
+"""L0 trace layer tests (SURVEY.md §4 "Trace-parser tests")."""
+import os
+
+import numpy as np
+import pytest
+
+from rlgpuschedule_tpu.traces import (
+    ArrayTrace, JobRecord, STATUS_FAILED, STATUS_KILLED, STATUS_PASS,
+    gen_poisson_jobs, gen_poisson_trace, load_pai_jobs, load_philly_jobs,
+    to_array_trace, from_array_trace,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+class TestJobRecord:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            JobRecord(0, 0.0, 0.0, 1)
+        with pytest.raises(ValueError):
+            JobRecord(0, 0.0, 10.0, 0)
+        with pytest.raises(ValueError):
+            JobRecord(0, -1.0, 10.0, 1)
+
+    def test_array_roundtrip(self):
+        jobs = [JobRecord(0, 5.0, 10.0, 2, 1), JobRecord(1, 0.0, 3.0, 1, 0)]
+        tr = to_array_trace(jobs, max_jobs=4)
+        assert tr.max_jobs == 4 and tr.num_jobs == 2
+        # sorted by submit: job 1 first
+        assert tr.submit[0] == 0.0 and tr.gpus[0] == 1
+        assert np.isinf(tr.submit[2]) and not tr.valid[2]
+        back = from_array_trace(tr)
+        assert [(j.submit, j.gpus) for j in back] == [(0.0, 1), (5.0, 2)]
+
+    def test_overflow_raises(self):
+        with pytest.raises(ValueError):
+            to_array_trace([JobRecord(i, 0.0, 1.0, 1) for i in range(3)], max_jobs=2)
+
+    def test_slice_rebases(self):
+        jobs = [JobRecord(i, 10.0 * i, 5.0, 1) for i in range(6)]
+        tr = to_array_trace(jobs)
+        win = tr.slice(2, 3)
+        assert win.num_jobs == 3 and win.max_jobs == 3
+        np.testing.assert_allclose(win.submit, [0.0, 10.0, 20.0])
+
+
+class TestSynthetic:
+    def test_deterministic(self):
+        a = gen_poisson_jobs(rate=0.1, n_jobs=50, seed=7)
+        b = gen_poisson_jobs(rate=0.1, n_jobs=50, seed=7)
+        assert a == b
+        c = gen_poisson_jobs(rate=0.1, n_jobs=50, seed=8)
+        assert a != c
+
+    def test_statistics(self):
+        jobs = gen_poisson_jobs(rate=0.5, n_jobs=4000, seed=0, mean_duration=100.0)
+        submits = np.array([j.submit for j in jobs])
+        inter = np.diff(submits)
+        assert abs(inter.mean() - 2.0) < 0.2          # 1/rate
+        durs = np.array([j.duration for j in jobs])
+        assert abs(durs.mean() - 100.0) / 100.0 < 0.15  # lognormal mean
+        assert all(j.gpus in (1, 2, 4, 8) for j in jobs)
+        assert submits[0] == 0.0 and np.all(np.diff(submits) >= 0)
+
+    def test_trace_padding(self):
+        tr = gen_poisson_trace(rate=1.0, n_jobs=10, seed=1, max_jobs=16)
+        assert tr.max_jobs == 16 and tr.num_jobs == 10
+
+
+class TestPhilly:
+    def test_golden_fixture(self):
+        jobs = load_philly_jobs(os.path.join(FIXTURES, "philly_small.csv"))
+        # j4 is dropped (0 gpus, no times); 5 survive
+        assert len(jobs) == 5
+        j1, j2, j3, j5, j6 = jobs
+        assert j1.submit == 0.0 and j1.duration == 600.0 and j1.gpus == 1
+        assert j2.submit == 5.0 and j2.duration == 1200.0 and j2.gpus == 4
+        assert j3.status == STATUS_KILLED and j5.status == STATUS_FAILED
+        assert j6.status == STATUS_PASS
+        # tenants dense-mapped; alice appears twice with one id
+        assert j1.tenant == j3.tenant
+        assert len({j.tenant for j in jobs}) == 3  # alice, bob, dave (carol dropped)
+
+    def test_max_jobs(self):
+        jobs = load_philly_jobs(os.path.join(FIXTURES, "philly_small.csv"), max_jobs=2)
+        assert len(jobs) == 2
+
+
+class TestPAI:
+    def test_golden_fixture(self):
+        jobs = load_pai_jobs(os.path.join(FIXTURES, "pai_small.csv"))
+        # t5 dropped (0 gpu); plan_gpu is percent: 100->1, 50->1, 200->2, 400->4
+        assert len(jobs) == 4
+        assert [j.gpus for j in jobs] == [1, 1, 2, 4]
+        assert jobs[0].submit == 0.0
+        assert jobs[1].duration == 900.0
+        # 3 distinct tenants
+        assert len({j.tenant for j in jobs}) == 3
