@@ -1,0 +1,12 @@
+"""L1 simulator layer: exact Python oracle + jit/vmap JAX core."""
+from .oracle import (OracleSim, pack_placement, spread_placement,
+                     NOT_ARRIVED, PENDING, RUNNING, DONE, PACK, SPREAD)
+from .schedulers import (SchedulerPolicy, fifo, sjf, srtf, tiresias,
+                         BASELINES, run_scheduler, evaluate_baselines)
+
+__all__ = [
+    "OracleSim", "pack_placement", "spread_placement",
+    "NOT_ARRIVED", "PENDING", "RUNNING", "DONE", "PACK", "SPREAD",
+    "SchedulerPolicy", "fifo", "sjf", "srtf", "tiresias",
+    "BASELINES", "run_scheduler", "evaluate_baselines",
+]

@@ -1,0 +1,242 @@
+"""Exact event-driven oracle simulator (L1) — the executable spec.
+
+Capability parity: SURVEY.md §2 "Cluster model", "Event-driven sim engine",
+"Gang scheduler mechanics", "Preemption support". This is the slow, obviously-
+correct Python implementation of the cluster semantics. It exists for three
+reasons (SURVEY.md §7 step 2):
+
+1. It IS the specification: the jit/vmap JAX simulator (``sim.core``) is
+   property-tested to produce bit-identical schedules against this oracle.
+2. Baseline schedulers (FIFO/SJF/SRTF/Tiresias, ``sim.schedulers``) run on it
+   to produce the JCT comparison tables.
+3. Full-trace evaluation (hundreds of thousands of jobs) runs here on host
+   CPU, where a priority queue beats a fixed-shape scan.
+
+Shared semantics (must match ``sim.core`` exactly):
+
+- Cluster: ``n_nodes`` × ``gpus_per_node`` interchangeable GPUs; jobs may span
+  nodes; gang all-or-nothing: a job runs only with its full GPU demand.
+- Job lifecycle: NOT_ARRIVED → PENDING (clock ≥ submit) → RUNNING → DONE.
+  Preemption: RUNNING → PENDING, attained service preserved (no restart-from-
+  scratch penalty; matches Tiresias' model of checkpointed preemption).
+- Placement is deterministic given the free-GPU vector:
+  * PACK:   nodes sorted by (free desc, node id asc); fill greedily.
+  * SPREAD: water-filling — smallest level t with Σ min(free_i, t) ≥ demand;
+    alloc_i = min(free_i, t); excess trimmed from the highest node ids whose
+    allocation equals t.
+- Time advances only between decision points, to the next event:
+  min(next arrival, next completion). Completions are processed before
+  arrivals at the same instant.
+- JCT(j) = finish(j) − submit(j).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..traces.records import ArrayTrace, JobRecord
+
+NOT_ARRIVED, PENDING, RUNNING, DONE = 0, 1, 2, 3
+PACK, SPREAD = 0, 1
+
+
+def pack_placement(free: np.ndarray, demand: int) -> np.ndarray | None:
+    """Fill the freest nodes first; ties broken by lowest node id."""
+    if demand > int(free.sum()):
+        return None
+    order = np.lexsort((np.arange(len(free)), -free))  # free desc, id asc
+    alloc = np.zeros_like(free)
+    left = demand
+    for n in order:
+        take = min(int(free[n]), left)
+        alloc[n] = take
+        left -= take
+        if left == 0:
+            break
+    return alloc
+
+
+def spread_placement(free: np.ndarray, demand: int) -> np.ndarray | None:
+    """Water-filling: balance the allocation as evenly as the free vector
+    allows. Excess (when Σ min(free, t) overshoots) is trimmed from the
+    highest node ids among nodes allocated exactly t."""
+    if demand > int(free.sum()):
+        return None
+    t = 0
+    while int(np.minimum(free, t).sum()) < demand:
+        t += 1
+    alloc = np.minimum(free, t).astype(free.dtype)
+    excess = int(alloc.sum()) - demand
+    if excess > 0:
+        at_t = [n for n in range(len(free)) if alloc[n] == t]
+        for n in sorted(at_t, reverse=True)[:excess]:
+            alloc[n] -= 1
+    return alloc
+
+
+class OracleSim:
+    """Exact discrete-event simulation of one cluster over one trace."""
+
+    def __init__(self, trace: ArrayTrace | list[JobRecord], n_nodes: int,
+                 gpus_per_node: int):
+        if isinstance(trace, list):
+            from ..traces.records import to_array_trace
+            trace = to_array_trace(trace)
+        self.trace = trace
+        self.n_nodes = n_nodes
+        self.gpus_per_node = gpus_per_node
+        self.capacity = n_nodes * gpus_per_node
+        if trace.num_jobs and int(trace.gpus[trace.valid].max()) > self.capacity:
+            raise ValueError("a job demands more GPUs than the cluster has")
+        self.reset()
+
+    def reset(self):
+        J = self.trace.max_jobs
+        self.clock = 0.0
+        self.status = np.where(self.trace.valid, NOT_ARRIVED, DONE).astype(np.int32)
+        self.remaining = self.trace.duration.astype(np.float64).copy()
+        self.start = np.full(J, np.nan)
+        self.finish = np.full(J, np.nan)
+        self.alloc = np.zeros((J, self.n_nodes), np.int32)
+        self.free = np.full(self.n_nodes, self.gpus_per_node, np.int32)
+        self._process_arrivals()
+        return self
+
+    # ---- events ------------------------------------------------------------
+
+    def _process_arrivals(self):
+        arrived = (self.status == NOT_ARRIVED) & (self.trace.submit <= self.clock)
+        self.status[arrived] = PENDING
+
+    def next_event_time(self) -> float:
+        """Earliest future arrival or completion; +inf if neither exists."""
+        t = np.inf
+        na = self.status == NOT_ARRIVED
+        if na.any():
+            t = min(t, float(self.trace.submit[na].min()))
+        run = self.status == RUNNING
+        if run.any():
+            t = min(t, self.clock + float(self.remaining[run].min()))
+        return t
+
+    def advance_to(self, t: float) -> float:
+        """Advance the clock to ``t`` (≤ next event time; schedulers may pass
+        an earlier timer wake, e.g. a Tiresias demotion instant). Completions
+        falling exactly on ``t`` are processed before arrivals. Returns dt."""
+        if not np.isfinite(t):
+            return 0.0
+        if t > self.next_event_time() + 1e-9:
+            raise ValueError("advance_to would skip over an event")
+        dt = t - self.clock
+        run = self.status == RUNNING
+        self.remaining[run] -= dt
+        self.clock = t
+        completed = run & (self.remaining <= 1e-9)
+        for j in np.flatnonzero(completed):
+            self.status[j] = DONE
+            self.finish[j] = t
+            self.remaining[j] = 0.0
+            self.free += self.alloc[j]
+            self.alloc[j] = 0
+        self._process_arrivals()
+        return dt
+
+    def advance_to_next_event(self) -> float:
+        """Advance the clock to the next event; returns dt (0 if no event)."""
+        return self.advance_to(self.next_event_time())
+
+    # ---- scheduling actions ------------------------------------------------
+
+    def try_place(self, j: int, mode: int = PACK) -> bool:
+        """Gang-place pending job j; returns False if infeasible/not pending."""
+        if self.status[j] != PENDING:
+            return False
+        demand = int(self.trace.gpus[j])
+        place = (pack_placement if mode == PACK else spread_placement)(self.free, demand)
+        if place is None:
+            return False
+        self.alloc[j] = place
+        self.free -= place
+        self.status[j] = RUNNING
+        if np.isnan(self.start[j]):
+            self.start[j] = self.clock
+        return True
+
+    def preempt(self, j: int) -> bool:
+        if self.status[j] != RUNNING:
+            return False
+        self.free += self.alloc[j]
+        self.alloc[j] = 0
+        self.status[j] = PENDING
+        return True
+
+    def rl_step(self, action: int, queue_len: int, n_placements: int = 1
+                ) -> dict:
+        """One RL decision-point step — the reference semantics that the
+        jitted ``sim.core.rl_step`` must reproduce exactly (SURVEY.md §3.2).
+
+        Action encoding: ``action == queue_len * n_placements`` is no-op;
+        otherwise slot ``action // n_placements`` of the pending queue with
+        placement mode ``action % n_placements`` (0=pack, 1=spread).
+
+        Semantics: a successful placement costs no simulated time (the agent
+        acts again at the same instant). A no-op / invalid / infeasible action
+        advances the clock to the next event. If no future event exists
+        (nothing running ⇒ cluster fully free) the head-of-queue job is
+        force-placed to guarantee progress — it is always feasible because
+        per-job demand ≤ capacity is enforced at construction.
+        """
+        queue = self.pending_jobs()[:queue_len]
+        placed = False
+        if action < queue_len * n_placements:
+            k, p = divmod(action, n_placements)
+            if k < len(queue):
+                placed = self.try_place(queue[k], p)
+        dt, n_before = 0.0, self.in_system()
+        if not placed:
+            t = self.next_event_time()
+            if np.isfinite(t):
+                dt = self.advance_to(t)
+            elif queue:
+                assert self.try_place(queue[0], PACK)
+                placed = True
+        return {"placed": placed, "dt": dt, "in_system_before": n_before,
+                "done": self.done()}
+
+    # ---- queries -----------------------------------------------------------
+
+    def pending_jobs(self) -> list[int]:
+        """Pending job ids ordered by (submit asc, id asc) — the queue order
+        the RL action space indexes into."""
+        pend = np.flatnonzero(self.status == PENDING)
+        return sorted(pend, key=lambda j: (self.trace.submit[j], j))
+
+    def running_jobs(self) -> list[int]:
+        return list(np.flatnonzero(self.status == RUNNING))
+
+    def in_system(self) -> int:
+        return int(((self.status == PENDING) | (self.status == RUNNING)).sum())
+
+    def done(self) -> bool:
+        return bool((self.status[self.trace.valid] == DONE).all())
+
+    def attained_service(self, j: int) -> float:
+        """GPU-seconds of service attained (Tiresias' priority key)."""
+        executed = float(self.trace.duration[j]) - float(self.remaining[j])
+        return executed * float(self.trace.gpus[j])
+
+    def jcts(self) -> np.ndarray:
+        v = self.trace.valid & (self.status == DONE)
+        return (self.finish[v] - self.trace.submit[v]).astype(np.float64)
+
+    def avg_jct(self) -> float:
+        j = self.jcts()
+        return float(j.mean()) if len(j) else float("nan")
+
+    def utilization(self) -> float:
+        """Fraction of GPUs currently busy."""
+        return 1.0 - float(self.free.sum()) / self.capacity
+
+    def gpus_consistent(self) -> bool:
+        """Conservation invariant: allocated + free == capacity, per node."""
+        used = self.alloc.sum(axis=0)
+        return bool((used + self.free == self.gpus_per_node).all())

@@ -1,0 +1,143 @@
+"""Baseline schedulers on the oracle sim (L1/L6).
+
+Capability parity: SURVEY.md §2 "Baseline schedulers" — a Tiresias-like
+discretized two-dimensional LAS scheduler (the reference's comparison
+baseline, `[B]`) plus FIFO/SJF/SRTF for the eval tables (`[K]`).
+
+All baselines share one event loop (:func:`run_scheduler`): at every event the
+scheduler produces a priority ordering over in-system jobs; the loop then
+greedily admits jobs in that order while the gang fits, preempting (if the
+policy is preemptive) any running job that fell out of the admitted set. This
+uniform mechanism is itself a correctness check on the oracle — FIFO/SJF JCTs
+on tiny traces are hand-verifiable (SURVEY.md §4 "Baseline-scheduler oracle
+tests").
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+import numpy as np
+
+from .oracle import OracleSim, PACK, PENDING, RUNNING
+
+
+@dataclasses.dataclass
+class SchedulerPolicy:
+    """A baseline: a priority key over in-system jobs + preemption flag.
+
+    ``key(sim, j)`` — lower sorts first. Non-preemptive policies keep running
+    jobs running unconditionally and only order the pending queue.
+    ``next_wake(sim)`` — earliest future instant at which the policy's
+    priorities change *between* events (e.g. a Tiresias queue demotion);
+    the event loop advances to min(next event, next wake).
+    """
+    name: str
+    key: Callable[[OracleSim, int], tuple]
+    preemptive: bool = False
+    next_wake: Callable[[OracleSim], float] = lambda s: float("inf")
+
+
+def fifo() -> SchedulerPolicy:
+    return SchedulerPolicy("fifo", lambda s, j: (s.trace.submit[j], j))
+
+
+def sjf() -> SchedulerPolicy:
+    """Shortest job first (non-preemptive, by total service demand)."""
+    return SchedulerPolicy("sjf", lambda s, j: (s.trace.duration[j], j))
+
+
+def srtf() -> SchedulerPolicy:
+    """Shortest remaining time first (preemptive)."""
+    return SchedulerPolicy("srtf", lambda s, j: (s.remaining[j], j), preemptive=True)
+
+
+def tiresias(thresholds: Sequence[float] = (3600.0, 36000.0)) -> SchedulerPolicy:
+    """Tiresias-like discretized 2D-LAS (`[B]` baseline; design per the
+    Tiresias NSDI'19 scheme, `[K]`): priority = attained GPU-service
+    (gpus × executed seconds) discretized into queues by ``thresholds``;
+    within a queue, FIFO by submit time. Preemptive: newly-arrived jobs sit in
+    the highest queue and can preempt demoted long-running jobs. The 2D part
+    is exactly that service is *GPU-time*, so wide gangs demote sooner."""
+    th = np.asarray(sorted(thresholds), np.float64)
+
+    def key(s: OracleSim, j: int):
+        q = int(np.searchsorted(th, s.attained_service(j), side="right"))
+        return (q, s.trace.submit[j], j)
+
+    def next_wake(s: OracleSim) -> float:
+        """Earliest instant a running job's attained GPU-service crosses its
+        next demotion threshold."""
+        t = float("inf")
+        for j in s.running_jobs():
+            a = s.attained_service(j)
+            nxt = th[np.searchsorted(th, a, side="right"):]
+            if len(nxt):
+                t = min(t, s.clock + (float(nxt[0]) - a) / float(s.trace.gpus[j]))
+        return t
+
+    return SchedulerPolicy("tiresias", key, preemptive=True, next_wake=next_wake)
+
+
+BASELINES: dict[str, Callable[[], SchedulerPolicy]] = {
+    "fifo": fifo, "sjf": sjf, "srtf": srtf, "tiresias": tiresias,
+}
+
+
+def schedule_step(sim: OracleSim, policy: SchedulerPolicy,
+                  placement: int = PACK) -> None:
+    """Apply one scheduling decision round at the current instant."""
+    if policy.preemptive:
+        insys = [j for j in range(sim.trace.max_jobs)
+                 if sim.status[j] in (PENDING, RUNNING)]
+        order = sorted(insys, key=lambda j: policy.key(sim, j))
+        # Greedy prefix admission: walk the priority order, keep/place while
+        # the gang fits. Anything running but not admitted is preempted first
+        # so its GPUs are available to higher-priority jobs.
+        budget = int(sim.free.sum()) + sum(int(sim.trace.gpus[j]) for j in sim.running_jobs())
+        admitted = []
+        for j in order:
+            d = int(sim.trace.gpus[j])
+            if d <= budget:
+                admitted.append(j)
+                budget -= d
+        admitted_set = set(admitted)
+        for j in sim.running_jobs():
+            if j not in admitted_set:
+                sim.preempt(j)
+        for j in admitted:
+            if sim.status[j] == PENDING:
+                sim.try_place(j, placement)
+    else:
+        for j in sorted(sim.pending_jobs(), key=lambda j: policy.key(sim, j)):
+            sim.try_place(j, placement)
+
+
+def run_scheduler(sim: OracleSim, policy: SchedulerPolicy,
+                  placement: int = PACK, max_events: int = 10_000_000) -> OracleSim:
+    """Run ``policy`` to trace completion; returns the finished sim."""
+    sim.reset()
+    for _ in range(max_events):
+        schedule_step(sim, policy, placement)
+        if sim.done():
+            return sim
+        t = min(sim.next_event_time(), policy.next_wake(sim))
+        if not np.isfinite(t):
+            raise RuntimeError("scheduler deadlock: pending jobs but no events")
+        if sim.advance_to(t) <= 0.0 and not sim.done():
+            # zero-dt wake (threshold exactly at clock): avoid spinning
+            if sim.advance_to_next_event() == 0.0:
+                raise RuntimeError("scheduler made no progress")
+    raise RuntimeError("max_events exceeded")
+
+
+def evaluate_baselines(trace, n_nodes: int, gpus_per_node: int,
+                       names: Sequence[str] = ("fifo", "sjf", "srtf", "tiresias"),
+                       ) -> dict[str, float]:
+    """Avg-JCT table for the requested baselines on one trace."""
+    out = {}
+    for name in names:
+        sim = OracleSim(trace, n_nodes, gpus_per_node)
+        run_scheduler(sim, BASELINES[name]())
+        out[name] = sim.avg_jct()
+    return out

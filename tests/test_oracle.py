@@ -1,0 +1,125 @@
+"""Oracle simulator unit tests: deterministic event ordering, gang
+all-or-nothing, conservation, hand-computed JCTs (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+from rlgpuschedule_tpu.sim.oracle import (
+    OracleSim, pack_placement, spread_placement,
+    NOT_ARRIVED, PENDING, RUNNING, DONE, PACK, SPREAD,
+)
+from rlgpuschedule_tpu.traces import JobRecord
+
+
+def J(i, submit, dur, gpus, tenant=0):
+    return JobRecord(i, float(submit), float(dur), gpus, tenant)
+
+
+class TestPlacement:
+    def test_pack_prefers_freest(self):
+        free = np.array([2, 4, 1], np.int32)
+        np.testing.assert_array_equal(pack_placement(free, 5), [1, 4, 0])
+
+    def test_pack_tie_breaks_low_id(self):
+        free = np.array([3, 3, 3], np.int32)
+        np.testing.assert_array_equal(pack_placement(free, 4), [3, 1, 0])
+
+    def test_pack_infeasible(self):
+        assert pack_placement(np.array([1, 1], np.int32), 3) is None
+
+    def test_spread_water_fills(self):
+        free = np.array([4, 4, 4], np.int32)
+        np.testing.assert_array_equal(spread_placement(free, 6), [2, 2, 2])
+
+    def test_spread_trims_high_ids(self):
+        free = np.array([4, 4, 4], np.int32)
+        # t=3 gives 9 >= 7, excess 2 trimmed from nodes 2 then 1
+        np.testing.assert_array_equal(spread_placement(free, 7), [3, 2, 2])
+
+    def test_spread_respects_free(self):
+        free = np.array([1, 5, 0], np.int32)
+        np.testing.assert_array_equal(spread_placement(free, 4), [1, 3, 0])
+
+    def test_exact_fit(self):
+        free = np.array([2, 2], np.int32)
+        assert pack_placement(free, 4).sum() == 4
+        assert spread_placement(free, 4).sum() == 4
+
+
+class TestOracleSemantics:
+    def test_arrival_and_lifecycle(self):
+        sim = OracleSim([J(0, 0, 10, 1), J(1, 5, 10, 1)], n_nodes=1, gpus_per_node=2)
+        assert sim.status[0] == PENDING and sim.status[1] == NOT_ARRIVED
+        assert sim.try_place(0)
+        assert sim.status[0] == RUNNING and sim.start[0] == 0.0
+        sim.advance_to_next_event()  # t=5 arrival
+        assert sim.clock == 5.0 and sim.status[1] == PENDING
+        assert sim.try_place(1)
+        sim.advance_to_next_event()  # t=10: job0 completes
+        assert sim.clock == 10.0 and sim.status[0] == DONE and sim.finish[0] == 10.0
+        sim.advance_to_next_event()  # t=15: job1 completes
+        assert sim.done()
+        np.testing.assert_allclose(sim.jcts(), [10.0, 10.0])
+
+    def test_gang_all_or_nothing(self):
+        sim = OracleSim([J(0, 0, 5, 3)], n_nodes=2, gpus_per_node=2)
+        assert sim.try_place(0)          # spans nodes: 2 + 1
+        assert sim.alloc[0].sum() == 3
+
+    def test_demand_over_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            OracleSim([J(0, 0, 5, 5)], n_nodes=2, gpus_per_node=2)
+
+    def test_infeasible_not_partially_placed(self):
+        sim = OracleSim([J(0, 0, 5, 2), J(1, 0, 5, 3)], n_nodes=2, gpus_per_node=2)
+        assert sim.try_place(0)
+        assert not sim.try_place(1)      # only 2 free, needs 3
+        assert sim.alloc[1].sum() == 0 and sim.status[1] == PENDING
+        assert sim.gpus_consistent()
+
+    def test_conservation_through_lifecycle(self):
+        sim = OracleSim([J(0, 0, 4, 2), J(1, 1, 3, 4), J(2, 2, 2, 1)],
+                        n_nodes=2, gpus_per_node=4)
+        sim.try_place(0, PACK)
+        assert sim.gpus_consistent()
+        sim.advance_to_next_event()
+        sim.try_place(1, SPREAD)
+        assert sim.gpus_consistent()
+        sim.advance_to_next_event()
+        sim.try_place(2)
+        assert sim.gpus_consistent()
+        while not sim.done():
+            sim.advance_to_next_event()
+        assert sim.gpus_consistent() and sim.free.sum() == 8
+
+    def test_preemption_preserves_attained_service(self):
+        sim = OracleSim([J(0, 0, 10, 2)], n_nodes=1, gpus_per_node=2)
+        sim.try_place(0)
+        sim.advance_to(4.0)
+        assert sim.remaining[0] == 6.0
+        assert sim.preempt(0)
+        assert sim.status[0] == PENDING and sim.free.sum() == 2
+        assert sim.attained_service(0) == 8.0  # 4s × 2 gpus
+        sim.try_place(0)
+        sim.advance_to_next_event()
+        assert sim.clock == 10.0 and sim.done()  # 4 run + 6 remaining
+
+    def test_completion_before_arrival_same_instant(self):
+        sim = OracleSim([J(0, 0, 5, 2), J(1, 5, 1, 2)], n_nodes=1, gpus_per_node=2)
+        sim.try_place(0)
+        sim.advance_to_next_event()  # t=5: completion AND arrival
+        assert sim.status[0] == DONE and sim.status[1] == PENDING
+        assert sim.try_place(1)      # GPUs already released
+
+    def test_advance_cannot_skip_events(self):
+        sim = OracleSim([J(0, 0, 5, 1)], n_nodes=1, gpus_per_node=1)
+        sim.try_place(0)
+        with pytest.raises(ValueError):
+            sim.advance_to(7.0)
+
+    def test_queue_order(self):
+        # to_array_trace sorts rows by submit; queue is (submit asc, row asc)
+        sim = OracleSim([J(0, 3, 1, 1), J(1, 0, 1, 1), J(2, 3, 1, 1)],
+                        n_nodes=1, gpus_per_node=1)
+        np.testing.assert_allclose(sim.trace.submit, [0.0, 3.0, 3.0])
+        sim.advance_to(3.0)
+        assert sim.pending_jobs() == [0, 1, 2]
