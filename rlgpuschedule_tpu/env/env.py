@@ -1,0 +1,139 @@
+"""Gym-style pure-functional cluster environment (L2).
+
+Capability parity: SURVEY.md §2 "Gym-style env wrapper" / "Vectorized env":
+``reset/step`` over the jitted simulator, an episode = one trace-window
+replay, action masking for infeasible placements, and vectorization via
+``jax.vmap`` over a batched Trace pytree (the reference's subprocess/serial
+VecEnv becomes a vmap axis — SURVEY.md §2 "rebuild: vmap").
+
+Everything is pure: ``step`` is (params, state, action) → (state', timestep),
+so the whole interaction loop fuses into one ``lax.scan`` with the policy
+(Anakin pattern, SURVEY.md §7 step 5).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Literal, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..sim import core
+from ..sim.core import SimParams, SimState, Trace, StepInfo
+from ..traces.records import ArrayTrace
+from . import obs as obs_lib
+from . import rewards as reward_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvParams:
+    """Static env configuration (hashable; closed over by jit)."""
+    sim: SimParams
+    obs_kind: Literal["flat", "grid", "graph"] = "flat"
+    reward_kind: Literal["jct", "fair"] = "jct"
+    n_tenants: int = 1
+    time_scale: float = 600.0     # normalizes times in observations
+    reward_scale: float = 1000.0  # divides reward magnitudes
+    horizon: int = 512            # max decision steps per episode
+
+    @property
+    def n_actions(self) -> int:
+        return self.sim.n_actions
+
+    def obs_shape(self) -> tuple[int, ...]:
+        s, k = self.sim, self.sim.queue_len
+        if self.obs_kind == "flat":
+            return (s.n_nodes + 4 * k + 2,)
+        if self.obs_kind == "grid":
+            return (s.n_nodes + k, s.gpus_per_node, 2)
+        return (s.n_nodes + k, obs_lib.GRAPH_FEATURES)
+
+
+class EnvState(NamedTuple):
+    sim: SimState
+    t: jax.Array  # i32 decision-step counter within the episode
+
+
+class TimeStep(NamedTuple):
+    obs: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    action_mask: jax.Array
+    info: StepInfo
+
+
+def build_obs(params: EnvParams, sim: SimState, trace: Trace) -> jax.Array:
+    fn = {"flat": obs_lib.flat_obs, "grid": obs_lib.grid_obs,
+          "graph": obs_lib.graph_obs}[params.obs_kind]
+    return fn(params.sim, sim, trace, params.time_scale)
+
+
+def reset(params: EnvParams, trace: Trace) -> tuple[EnvState, TimeStep]:
+    sim = core.init_state(params.sim, trace)
+    state = EnvState(sim=sim, t=jnp.int32(0))
+    ts = TimeStep(
+        obs=build_obs(params, sim, trace),
+        reward=jnp.float32(0.0),
+        done=jnp.bool_(False),
+        action_mask=core.action_mask(params.sim, sim, trace),
+        info=StepInfo(placed=jnp.bool_(False), dt=jnp.float32(0.0),
+                      in_system_before=core.in_system(sim),
+                      done=jnp.bool_(False)),
+    )
+    return state, ts
+
+
+def step(params: EnvParams, state: EnvState, trace: Trace,
+         action: jax.Array) -> tuple[EnvState, TimeStep]:
+    sim_before = state.sim
+    sim, info = core.rl_step(params.sim, sim_before, trace, action)
+    if params.reward_kind == "fair":
+        reward = reward_lib.reward_fair(sim_before, trace, info,
+                                        params.n_tenants, params.reward_scale)
+    else:
+        reward = reward_lib.reward_jct(info, params.reward_scale)
+    t = state.t + 1
+    done = info.done | (t >= params.horizon)
+    new_state = EnvState(sim=sim, t=t)
+    ts = TimeStep(obs=build_obs(params, sim, trace), reward=reward, done=done,
+                  action_mask=core.action_mask(params.sim, sim, trace),
+                  info=info)
+    return new_state, ts
+
+
+def auto_reset_step(params: EnvParams, state: EnvState, trace: Trace,
+                    action: jax.Array) -> tuple[EnvState, TimeStep]:
+    """Step, and on episode end return the reset state (obs/mask from the
+    fresh episode, reward/done from the finished one) — the standard fused
+    auto-reset so rollouts never leave the device."""
+    stepped, ts = step(params, state, trace, action)
+    fresh, fresh_ts = reset(params, trace)
+    new_state = jax.tree.map(lambda a, b: jnp.where(ts.done, a, b),
+                             fresh, stepped)
+    obs = jnp.where(ts.done, fresh_ts.obs, ts.obs)
+    mask = jnp.where(ts.done, fresh_ts.action_mask, ts.action_mask)
+    return new_state, ts._replace(obs=obs, action_mask=mask)
+
+
+# ---- vectorization ----------------------------------------------------------
+
+def stack_traces(traces: list[ArrayTrace],
+                 params: EnvParams | SimParams | None = None) -> Trace:
+    """Stack per-env trace windows into a batched Trace (leading axis E).
+    All windows must share max_jobs (pad at construction). Pass ``params``
+    to validate gang sizes against cluster capacity (see
+    ``sim.core.validate_trace``)."""
+    sim_params = params.sim if isinstance(params, EnvParams) else params
+    devs = [Trace.from_array_trace(t, sim_params) for t in traces]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *devs)
+
+
+def vec_reset(params: EnvParams, traces: Trace) -> tuple[EnvState, TimeStep]:
+    return jax.vmap(lambda tr: reset(params, tr))(traces)
+
+
+def vec_step(params: EnvParams, state: EnvState, traces: Trace,
+             actions: jax.Array) -> tuple[EnvState, TimeStep]:
+    return jax.vmap(lambda s, tr, a: auto_reset_step(params, s, tr, a)
+                    )(state, traces, actions)

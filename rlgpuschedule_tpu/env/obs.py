@@ -1,0 +1,122 @@
+"""Observation builders (L2): flat / occupancy-grid / topology-graph.
+
+Capability parity: SURVEY.md §2 "Observation builders" — node×GPU occupancy
+grid (image-like, CNN config 2), flat features (MLP config 1), topology graph
++ node features (GNN config 4). All are fixed-shape pure functions of
+(SimState, Trace) so they live inside the jitted rollout.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..sim.core import (SimParams, SimState, Trace, pending_queue, RUNNING,
+                        in_system, utilization)
+
+
+def queue_features(params: SimParams, state: SimState, trace: Trace
+                   ) -> jax.Array:
+    """Per-queue-slot features [K, 4]: demand/capacity, waiting time,
+    service demand (both in units of ``time_scale`` via the caller), valid."""
+    queue = pending_queue(params, state)                   # [K]
+    jc = jnp.clip(queue, 0, params.max_jobs - 1)
+    occupied = queue >= 0
+    valid = occupied.astype(jnp.float32)
+    demand = trace.gpus[jc].astype(jnp.float32) / params.capacity * valid
+    # where (not *valid): padding rows have submit=+inf, and (clock-inf)*0
+    # would be NaN and poison the whole vmapped obs batch
+    wait = jnp.where(occupied, state.clock - trace.submit[jc], 0.0)
+    service = jnp.where(occupied, trace.duration[jc], 0.0)
+    return jnp.stack([demand, wait, service, valid], axis=1)
+
+
+def flat_obs(params: SimParams, state: SimState, trace: Trace,
+             time_scale: float) -> jax.Array:
+    """[N + 4K + 2] vector: per-node free fraction, queue features,
+    utilization, normalized in-system count."""
+    free_frac = state.free.astype(jnp.float32) / params.gpus_per_node
+    qf = queue_features(params, state, trace)
+    qf = qf.at[:, 1].set(jnp.tanh(qf[:, 1] / time_scale))
+    qf = qf.at[:, 2].set(jnp.tanh(qf[:, 2] / time_scale))
+    util = utilization(params, state)
+    n_insys = in_system(state) / params.max_jobs
+    return jnp.concatenate([free_frac, qf.reshape(-1),
+                            jnp.stack([util, n_insys])]).astype(jnp.float32)
+
+
+def grid_obs(params: SimParams, state: SimState, trace: Trace,
+             time_scale: float) -> jax.Array:
+    """Occupancy image [N + K, G, 2] (the reference's CNN input shape class —
+    cluster occupancy stacked over queue-demand rows, SURVEY.md §2):
+
+    cluster rows n<N:  ch0 = GPU slot occupied; ch1 = node-average normalized
+                       remaining service painted on occupied slots.
+    queue rows:        ch0 = demand bar (capped at G); ch1 = normalized
+                       service demand painted on the bar.
+    """
+    N, G, K = params.n_nodes, params.gpus_per_node, params.queue_len
+    used = (params.gpus_per_node - state.free).astype(jnp.float32)    # [N]
+    slots = jnp.arange(G, dtype=jnp.float32)                          # [G]
+    occ = (slots[None, :] < used[:, None]).astype(jnp.float32)        # [N,G]
+    running = (state.status == RUNNING).astype(jnp.float32)
+    rem_n = jnp.einsum("jn,j->n", state.alloc.astype(jnp.float32),
+                       running * jnp.tanh(state.remaining / time_scale))
+    rem_avg = rem_n / jnp.maximum(used, 1.0)                          # [N]
+    cluster = jnp.stack([occ, occ * rem_avg[:, None]], axis=-1)       # [N,G,2]
+
+    queue = pending_queue(params, state)
+    jc = jnp.clip(queue, 0, params.max_jobs - 1)
+    valid = (queue >= 0).astype(jnp.float32)
+    demand = jnp.minimum(trace.gpus[jc], G).astype(jnp.float32) * valid
+    bar = (slots[None, :] < demand[:, None]).astype(jnp.float32)      # [K,G]
+    service = jnp.tanh(trace.duration[jc] / time_scale) * valid
+    qimg = jnp.stack([bar, bar * service[:, None]], axis=-1)          # [K,G,2]
+    return jnp.concatenate([cluster, qimg], axis=0)                   # [N+K,G,2]
+
+
+def build_adjacency(n_nodes: int, queue_len: int,
+                    nodes_per_rack: int | None = None) -> np.ndarray:
+    """Static topology adjacency [V, V], V = N + K: cluster nodes connected
+    within a rack (all-to-all if ``nodes_per_rack`` is None), every queue slot
+    connected to every cluster node (placement candidates), self-loops.
+    Static because cluster topology never changes — only features do."""
+    V = n_nodes + queue_len
+    a = np.zeros((V, V), np.float32)
+    if nodes_per_rack is None:
+        a[:n_nodes, :n_nodes] = 1.0
+    else:
+        for r0 in range(0, n_nodes, nodes_per_rack):
+            r1 = min(r0 + nodes_per_rack, n_nodes)
+            a[r0:r1, r0:r1] = 1.0
+    a[:n_nodes, n_nodes:] = 1.0   # node ↔ queue bipartite
+    a[n_nodes:, :n_nodes] = 1.0
+    np.fill_diagonal(a, 1.0)
+    return a
+
+
+GRAPH_FEATURES = 5
+
+
+def graph_obs(params: SimParams, state: SimState, trace: Trace,
+              time_scale: float) -> jax.Array:
+    """Node-feature matrix [N + K, 5] over the static topology graph:
+    cluster rows: [free_frac, used_frac, avg_remaining, 1, 0];
+    queue rows:   [demand/capacity, wait, service, 0, 1] (times tanh-squashed).
+    The adjacency comes from :func:`build_adjacency` (static)."""
+    N, G = params.n_nodes, params.gpus_per_node
+    free_frac = state.free.astype(jnp.float32) / G
+    used = (G - state.free).astype(jnp.float32)
+    running = (state.status == RUNNING).astype(jnp.float32)
+    rem_n = jnp.einsum("jn,j->n", state.alloc.astype(jnp.float32),
+                       running * jnp.tanh(state.remaining / time_scale))
+    rem_avg = rem_n / jnp.maximum(used, 1.0)
+    ones = jnp.ones((N,), jnp.float32)
+    cluster = jnp.stack([free_frac, 1.0 - free_frac, rem_avg,
+                         ones, 0.0 * ones], axis=1)            # [N,5]
+    qf = queue_features(params, state, trace)                  # [K,4]
+    wait = jnp.tanh(qf[:, 1] / time_scale)
+    service = jnp.tanh(qf[:, 2] / time_scale)
+    zeros = jnp.zeros((params.queue_len,), jnp.float32)
+    queue = jnp.stack([qf[:, 0], wait, service, zeros, qf[:, 3]], axis=1)
+    return jnp.concatenate([cluster, queue], axis=0)           # [N+K,5]

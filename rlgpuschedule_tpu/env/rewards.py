@@ -1,0 +1,38 @@
+"""Reward functions (L2).
+
+Capability parity: SURVEY.md §2 "Reward functions" — a JCT-minimizing reward
+and a multi-tenant fairness variant (config 3).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..sim.core import SimState, Trace, StepInfo, PENDING, RUNNING
+
+
+def reward_jct(info: StepInfo, reward_scale: float) -> jax.Array:
+    """Exact JCT objective: Σ JCT = ∫ n_in_system(t) dt, so accumulating
+    ``-dt · n_in_system`` over decision intervals makes the (undiscounted)
+    episode return equal −Σ JCT / scale. Scheduling actions cost dt = 0, so
+    only idling is penalized — no reward shaping needed."""
+    return -(info.dt * info.in_system_before.astype(jnp.float32)) / reward_scale
+
+
+def tenant_counts(state: SimState, trace: Trace, n_tenants: int) -> jax.Array:
+    """In-system job count per tenant, [n_tenants]."""
+    insys = (state.status == PENDING) | (state.status == RUNNING)
+    onehot = jax.nn.one_hot(trace.tenant, n_tenants, dtype=jnp.float32)
+    return jnp.sum(onehot * insys[:, None].astype(jnp.float32), axis=0)
+
+
+def reward_fair(state_before: SimState, trace: Trace, info: StepInfo,
+                n_tenants: int, reward_scale: float) -> jax.Array:
+    """Multi-tenant fairness: accumulate −dt · Σ_t n_t² (n_t = tenant t's
+    in-system count over the interval). The quadratic makes backlog
+    concentrated on one tenant cost more than the same backlog spread evenly
+    (Σ n_t² is minimized at equal shares for fixed Σ n_t), so the policy is
+    pushed toward finishing jobs AND serving tenants evenly — the fairness
+    pressure of config 3's multi-tenant reward."""
+    n_t = tenant_counts(state_before, trace, n_tenants)
+    return -(info.dt * jnp.sum(n_t * n_t)) / reward_scale

@@ -1,0 +1,359 @@
+"""Pure-functional, jit/vmap-able cluster simulator (L1) — the TPU hot path.
+
+Capability parity: SURVEY.md §1 L1/L2 TPU restatement — "the discrete-event
+GPU-cluster simulator becomes a jit-compiled, vmapped environment". This is
+the central rebuild challenge (SURVEY.md §7 step 2 and "hard parts" (a)):
+
+- State is a pytree of **fixed-shape** arrays (static shapes for XLA): a job
+  table ``[J]`` with status masks, a per-job allocation matrix ``[J, N]``,
+  a free-GPU vector ``[N]``, and a scalar clock.
+- The reference's Python priority queue is replaced by **masked argmin over
+  next-event times** — O(J) but fully vectorized, which is the idiomatic
+  TPU trade (SURVEY.md §7 step 2).
+- Every function here is a pure ``state -> state`` map built from
+  ``jnp.where`` masks — no data-dependent Python control flow, so the whole
+  step jits once and ``vmap``s over an env batch.
+
+Semantics are specified by ``sim.oracle.OracleSim`` and enforced by the
+property tests in ``tests/test_sim_core.py`` (bit-identical schedules on
+integer-valued traces).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..traces.records import ArrayTrace
+from .oracle import NOT_ARRIVED, PENDING, RUNNING, DONE, PACK, SPREAD
+
+INF = jnp.inf
+_EPS = 1e-5  # completion tolerance in float32 virtual time
+
+
+@dataclasses.dataclass(frozen=True)
+class SimParams:
+    """Static simulator configuration (hashable; closed over by jit)."""
+    n_nodes: int
+    gpus_per_node: int
+    max_jobs: int          # J: rows in the (padded) job table
+    queue_len: int = 16    # K: pending-queue slots visible to the agent
+    n_placements: int = 1  # P: 1 = pack only; 2 = pack|spread factored action
+
+    @property
+    def capacity(self) -> int:
+        return self.n_nodes * self.gpus_per_node
+
+    @property
+    def n_actions(self) -> int:
+        return self.queue_len * self.n_placements + 1  # + no-op
+
+
+class Trace(NamedTuple):
+    """Device-side trace (rows sorted by submit; padding has submit=+inf)."""
+    submit: jax.Array    # f32[J]
+    duration: jax.Array  # f32[J]
+    gpus: jax.Array      # i32[J]
+    tenant: jax.Array    # i32[J]
+    valid: jax.Array     # bool[J]
+
+    @staticmethod
+    def from_array_trace(tr: ArrayTrace, params: "SimParams | None" = None,
+                         ) -> "Trace":
+        """Upload a host trace; pass ``params`` to validate gang sizes
+        against cluster capacity (recommended — see :func:`validate_trace`)."""
+        if params is not None:
+            tr = validate_trace(params, tr)
+        return Trace(jnp.asarray(tr.submit), jnp.asarray(tr.duration),
+                     jnp.asarray(tr.gpus), jnp.asarray(tr.tenant),
+                     jnp.asarray(tr.valid))
+
+
+def validate_trace(params: SimParams, tr: ArrayTrace, clamp: bool = False,
+                   ) -> ArrayTrace:
+    """Host-side guard mirroring OracleSim's constructor check: a valid job
+    demanding more GPUs than the cluster has can never be placed, and inside
+    the jitted sim that surfaces as a silently frozen episode (no exception
+    can be raised from traced code). Raise here instead — or, with
+    ``clamp=True``, cap demands at capacity (useful when replaying a big
+    production trace on a small debug cluster)."""
+    over = tr.valid & (tr.gpus > params.capacity)
+    if not over.any():
+        return tr
+    if not clamp:
+        raise ValueError(
+            f"{int(over.sum())} job(s) demand more than the cluster's "
+            f"{params.capacity} GPUs (max demand {int(tr.gpus[tr.valid].max())}); "
+            f"pass clamp=True to cap demands at capacity")
+    gpus = np.minimum(tr.gpus, params.capacity)
+    return dataclasses.replace(tr, gpus=gpus)
+
+
+class SimState(NamedTuple):
+    """Dynamic simulator state — a pytree of fixed-shape arrays."""
+    clock: jax.Array      # f32 scalar
+    status: jax.Array     # i32[J]
+    remaining: jax.Array  # f32[J]
+    start: jax.Array      # f32[J] (+inf until started)
+    finish: jax.Array     # f32[J] (+inf until done)
+    alloc: jax.Array      # i32[J, N]
+    free: jax.Array       # i32[N]
+
+
+class StepInfo(NamedTuple):
+    """Per-step outcomes consumed by rewards/metrics."""
+    placed: jax.Array           # bool — action placed a job this step
+    dt: jax.Array               # f32 — simulated time advanced
+    in_system_before: jax.Array # i32 — arrived-not-done count during [t, t+dt)
+    done: jax.Array             # bool — all valid jobs DONE
+
+
+# ---- lifecycle --------------------------------------------------------------
+
+def init_state(params: SimParams, trace: Trace) -> SimState:
+    J, N = params.max_jobs, params.n_nodes
+    state = SimState(
+        clock=jnp.float32(0.0),
+        status=jnp.where(trace.valid, NOT_ARRIVED, DONE).astype(jnp.int32),
+        remaining=trace.duration.astype(jnp.float32),
+        start=jnp.full((J,), INF, jnp.float32),
+        finish=jnp.full((J,), INF, jnp.float32),
+        alloc=jnp.zeros((J, N), jnp.int32),
+        free=jnp.full((N,), params.gpus_per_node, jnp.int32),
+    )
+    return _process_arrivals(state, trace)
+
+
+def _process_arrivals(state: SimState, trace: Trace) -> SimState:
+    arrived = (state.status == NOT_ARRIVED) & (trace.submit <= state.clock)
+    return state._replace(
+        status=jnp.where(arrived, PENDING, state.status))
+
+
+# ---- events -----------------------------------------------------------------
+
+def next_event_time(state: SimState, trace: Trace) -> jax.Array:
+    """Earliest future arrival or completion; +inf if neither (masked min —
+    the vectorized replacement for the oracle's priority queue)."""
+    arrival = jnp.min(jnp.where(state.status == NOT_ARRIVED, trace.submit, INF))
+    completion = jnp.min(jnp.where(state.status == RUNNING,
+                                   state.clock + state.remaining, INF))
+    return jnp.minimum(arrival, completion)
+
+
+def advance_to(state: SimState, trace: Trace, t: jax.Array) -> SimState:
+    """Advance the clock to ``t`` (caller guarantees t ≤ next event; +inf is
+    a no-op). Completions at ``t`` are processed before arrivals, matching
+    ``OracleSim.advance_to``."""
+    finite = jnp.isfinite(t)
+    t = jnp.where(finite, t, state.clock)
+    dt = t - state.clock
+    running = state.status == RUNNING
+    remaining = jnp.where(running, state.remaining - dt, state.remaining)
+    completed = running & (remaining <= _EPS)
+    released = jnp.sum(state.alloc * completed[:, None].astype(jnp.int32), axis=0)
+    state = SimState(
+        clock=t,
+        status=jnp.where(completed, DONE, state.status),
+        remaining=jnp.where(completed, 0.0, remaining),
+        start=state.start,
+        finish=jnp.where(completed, t, state.finish),
+        alloc=jnp.where(completed[:, None], 0, state.alloc),
+        free=state.free + released,
+    )
+    return _process_arrivals(state, trace)
+
+
+# ---- placement (matches oracle.pack_placement / spread_placement) ----------
+
+def pack_placement(free: jax.Array, demand: jax.Array,
+                   ) -> tuple[jax.Array, jax.Array]:
+    """Fill freest nodes first (ties → lowest node id). Returns (alloc[N],
+    feasible). jnp.argsort is stable, so argsort(-free) reproduces the
+    oracle's (free desc, id asc) order."""
+    feasible = demand <= jnp.sum(free)
+    order = jnp.argsort(-free)
+    sorted_free = free[order]
+    before = jnp.cumsum(sorted_free) - sorted_free
+    take = jnp.clip(demand - before, 0, sorted_free)
+    alloc = jnp.zeros_like(free).at[order].set(take)
+    return jnp.where(feasible, alloc, 0), feasible
+
+
+def spread_placement(free: jax.Array, demand: jax.Array, gpus_per_node: int,
+                     ) -> tuple[jax.Array, jax.Array]:
+    """Water-filling: smallest level t with Σ min(free, t) ≥ demand;
+    excess trimmed from the highest node ids allocated exactly t."""
+    feasible = demand <= jnp.sum(free)
+    levels = jnp.arange(gpus_per_node + 1)                      # [G+1]
+    supply = jnp.sum(jnp.minimum(free[None, :], levels[:, None]), axis=1)
+    t = jnp.argmax(supply >= demand)                            # first true
+    alloc = jnp.minimum(free, t)
+    excess = jnp.sum(alloc) - demand
+    at_t = alloc == t
+    # rank 1.. from the highest node id among nodes at level t
+    rank_from_top = jnp.cumsum(at_t[::-1].astype(jnp.int32))[::-1]
+    trim = at_t & (rank_from_top <= excess)
+    alloc = jnp.where(trim, alloc - 1, alloc)
+    return jnp.where(feasible, alloc, 0), feasible
+
+
+def placement(free: jax.Array, demand: jax.Array, mode: jax.Array,
+              gpus_per_node: int, n_placements: int = 2,
+              ) -> tuple[jax.Array, jax.Array]:
+    """Traced-mode dispatch between pack (0) and spread (1). When the action
+    space has a single placement (``n_placements == 1``, a static Python
+    int), the spread branch is dropped at trace time — no dead water-filling
+    compute in the jitted hot path."""
+    pa, pf = pack_placement(free, demand)
+    if n_placements == 1:
+        return pa, pf
+    sa, sf = spread_placement(free, demand, gpus_per_node)
+    spread = mode == SPREAD
+    return jnp.where(spread, sa, pa), jnp.where(spread, sf, pf)
+
+
+# ---- scheduling actions -----------------------------------------------------
+
+def try_place(params: SimParams, state: SimState, trace: Trace,
+              j: jax.Array, mode: jax.Array) -> tuple[SimState, jax.Array]:
+    """Gang-place job row ``j`` (traced index; -1 = invalid). Returns
+    (state', success). All-or-nothing: infeasible → state unchanged."""
+    jc = jnp.clip(j, 0, params.max_jobs - 1)
+    pending = (j >= 0) & (state.status[jc] == PENDING)
+    demand = trace.gpus[jc]
+    alloc, feasible = placement(state.free, demand, mode, params.gpus_per_node,
+                                params.n_placements)
+    ok = pending & feasible
+    allocd = jnp.where(ok, alloc, 0)
+    row = jax.nn.one_hot(jc, params.max_jobs, dtype=jnp.int32) * ok.astype(jnp.int32)
+    return SimState(
+        clock=state.clock,
+        status=jnp.where(row.astype(bool), RUNNING, state.status),
+        remaining=state.remaining,
+        start=jnp.where(row.astype(bool),
+                        jnp.minimum(state.start, state.clock), state.start),
+        finish=state.finish,
+        alloc=state.alloc + row[:, None] * allocd[None, :],
+        free=state.free - allocd,
+    ), ok
+
+
+def preempt(state: SimState, j: jax.Array, max_jobs: int
+            ) -> tuple[SimState, jax.Array]:
+    """RUNNING → PENDING for job row ``j``; attained service preserved."""
+    jc = jnp.clip(j, 0, max_jobs - 1)
+    ok = (j >= 0) & (state.status[jc] == RUNNING)
+    row = (jax.nn.one_hot(jc, max_jobs, dtype=jnp.int32) * ok.astype(jnp.int32)
+           ).astype(bool)
+    released = jnp.sum(state.alloc * row[:, None].astype(jnp.int32), axis=0)
+    return state._replace(
+        status=jnp.where(row, PENDING, state.status),
+        alloc=jnp.where(row[:, None], 0, state.alloc),
+        free=state.free + released,
+    ), ok
+
+
+# ---- queue & queries --------------------------------------------------------
+
+def pending_queue(params: SimParams, state: SimState) -> jax.Array:
+    """Row indices of the first K pending jobs, -1 padded. Trace rows are
+    submit-sorted at construction, so row order IS the oracle's
+    (submit asc, id asc) queue order."""
+    K = params.queue_len
+    pending = state.status == PENDING
+    rank = jnp.cumsum(pending.astype(jnp.int32)) - 1
+    rows = jnp.arange(params.max_jobs, dtype=jnp.int32)
+    target = jnp.where(pending & (rank < K), rank, K)  # K = scatter-drop slot
+    return jnp.full((K + 1,), -1, jnp.int32).at[target].set(
+        jnp.where(pending & (rank < K), rows, -1), mode="drop")[:K]
+
+
+def in_system(state: SimState) -> jax.Array:
+    return jnp.sum((state.status == PENDING) | (state.status == RUNNING))
+
+
+def all_done(state: SimState, trace: Trace) -> jax.Array:
+    return jnp.all(jnp.where(trace.valid, state.status == DONE, True))
+
+
+def attained_service(state: SimState, trace: Trace) -> jax.Array:
+    """Per-job attained GPU-seconds (Tiresias priority key)."""
+    executed = trace.duration - state.remaining
+    return executed * trace.gpus.astype(jnp.float32)
+
+
+def action_mask(params: SimParams, state: SimState, trace: Trace) -> jax.Array:
+    """bool[n_actions]: queue-slot actions valid iff the slot holds a pending
+    job whose gang fits in the free GPUs (pack and spread share feasibility:
+    jobs may span nodes). No-op is always valid."""
+    queue = pending_queue(params, state)                       # [K]
+    jc = jnp.clip(queue, 0, params.max_jobs - 1)
+    demand = trace.gpus[jc]
+    ok = (queue >= 0) & (demand <= jnp.sum(state.free))        # [K]
+    slots = jnp.repeat(ok, params.n_placements)                # [K*P]
+    return jnp.concatenate([slots, jnp.ones((1,), bool)])
+
+
+# ---- the RL decision-point step --------------------------------------------
+
+def rl_step(params: SimParams, state: SimState, trace: Trace,
+            action: jax.Array) -> tuple[SimState, StepInfo]:
+    """One decision-point step; exact jit/vmap analogue of
+    ``OracleSim.rl_step`` (see its docstring for the semantics). Branchless:
+    both outcomes (placement vs time-advance) are computed and masked —
+    the idiomatic XLA trade against host control flow."""
+    K, P = params.queue_len, params.n_placements
+    queue = pending_queue(params, state)
+    is_noop = action >= K * P
+    k = jnp.clip(action // P, 0, K - 1)
+    mode = action % P
+    j = jnp.where(is_noop, -1, queue[k])
+
+    placed_state, placed = try_place(params, state, trace, j, mode)
+
+    # not placed → advance to next event, or force-place queue head if the
+    # event horizon is empty (nothing running ⇒ cluster free ⇒ feasible for
+    # any job with demand ≤ capacity — validate_trace enforces that on host;
+    # an over-capacity job would make forced_ok False and the episode can
+    # only end via the env horizon).
+    t_next = next_event_time(state, trace)
+    has_event = jnp.isfinite(t_next)
+    n_before = in_system(state)
+    advanced_state = advance_to(state, trace, t_next)
+    forced_state, forced_ok = try_place(params, state, trace, queue[0],
+                                        jnp.int32(PACK))
+
+    def pick(a, b, c):  # placed ? a : (has_event ? b : c)
+        return jnp.where(placed, a, jnp.where(has_event, b, c))
+
+    new_state = jax.tree.map(pick, placed_state, advanced_state, forced_state)
+    dt = jnp.where(placed | ~has_event, 0.0, t_next - state.clock)
+    info = StepInfo(placed=placed | (~placed & ~has_event & forced_ok),
+                    dt=dt, in_system_before=n_before,
+                    done=all_done(new_state, trace))
+    return new_state, info
+
+
+# ---- metrics ----------------------------------------------------------------
+
+def jct_stats(state: SimState, trace: Trace) -> dict[str, jax.Array]:
+    """Avg/max JCT over completed valid jobs (masked)."""
+    done = trace.valid & (state.status == DONE)
+    jct = jnp.where(done, state.finish - trace.submit, 0.0)
+    n = jnp.maximum(jnp.sum(done), 1)
+    return {"avg_jct": jnp.sum(jct) / n,
+            "max_jct": jnp.max(jnp.where(done, jct, -INF)),
+            "n_done": jnp.sum(done)}
+
+
+def utilization(params: SimParams, state: SimState) -> jax.Array:
+    return 1.0 - jnp.sum(state.free) / params.capacity
+
+
+def np_state(state: SimState) -> SimState:
+    """Host copy for debugging/tests."""
+    return jax.tree.map(np.asarray, state)

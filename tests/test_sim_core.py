@@ -1,0 +1,218 @@
+"""Property tests: the jit/vmap JAX simulator must reproduce the oracle
+exactly (SURVEY.md §7 step 2 — "property-test against a slow Python oracle
+sim written first as executable spec"). Integer-valued traces keep float32
+virtual time exact, so comparisons are bit-meaningful."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from rlgpuschedule_tpu.sim import oracle as O
+from rlgpuschedule_tpu.sim import core as C
+from rlgpuschedule_tpu.traces import JobRecord, to_array_trace
+
+
+def int_trace(rng, n_jobs, max_gpus, max_jobs=None):
+    """Random integer-valued trace (exact in float32)."""
+    jobs = []
+    t = 0
+    for i in range(n_jobs):
+        t += int(rng.integers(0, 30))
+        jobs.append(JobRecord(i, float(t), float(rng.integers(1, 50)),
+                              int(rng.integers(1, max_gpus + 1)),
+                              int(rng.integers(0, 3))))
+    return to_array_trace(jobs, max_jobs=max_jobs)
+
+
+class TestPlacementEquivalence:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_pack_matches_oracle(self, seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(50):
+            free = rng.integers(0, 9, size=6).astype(np.int32)
+            demand = int(rng.integers(1, 20))
+            want = O.pack_placement(free, demand)
+            got, feasible = C.pack_placement(jnp.asarray(free), jnp.asarray(demand))
+            if want is None:
+                assert not bool(feasible)
+            else:
+                assert bool(feasible)
+                np.testing.assert_array_equal(np.asarray(got), want)
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_spread_matches_oracle(self, seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(50):
+            free = rng.integers(0, 9, size=6).astype(np.int32)
+            demand = int(rng.integers(1, 20))
+            want = O.spread_placement(free, demand)
+            got, feasible = C.spread_placement(jnp.asarray(free),
+                                               jnp.asarray(demand), 8)
+            if want is None:
+                assert not bool(feasible)
+            else:
+                assert bool(feasible)
+                np.testing.assert_array_equal(np.asarray(got), want)
+
+
+class TestQueueAndMask:
+    def test_pending_queue_order_and_padding(self):
+        trace = to_array_trace([JobRecord(i, float(i), 5.0, 1) for i in range(6)],
+                               max_jobs=8)
+        params = C.SimParams(n_nodes=1, gpus_per_node=2, max_jobs=8, queue_len=4)
+        tr = C.Trace.from_array_trace(trace)
+        state = C.init_state(params, tr)
+        state = C.advance_to(state, tr, jnp.float32(3.0))  # jobs 0..3 pending
+        q = np.asarray(C.pending_queue(params, state))
+        np.testing.assert_array_equal(q, [0, 1, 2, 3])
+        # place job 0 → queue shifts, tail pads with next pending
+        state, ok = C.try_place(params, state, tr, jnp.int32(0), jnp.int32(0))
+        assert bool(ok)
+        q = np.asarray(C.pending_queue(params, state))
+        np.testing.assert_array_equal(q, [1, 2, 3, -1])
+
+    def test_action_mask(self):
+        trace = to_array_trace([JobRecord(0, 0.0, 5.0, 2), JobRecord(1, 0.0, 5.0, 4)],
+                               max_jobs=4)
+        params = C.SimParams(n_nodes=1, gpus_per_node=4, max_jobs=4,
+                             queue_len=3, n_placements=2)
+        tr = C.Trace.from_array_trace(trace)
+        state = C.init_state(params, tr)
+        mask = np.asarray(C.action_mask(params, state, tr))
+        # both jobs feasible on empty cluster; slot 2 empty; noop valid
+        np.testing.assert_array_equal(mask, [1, 1, 1, 1, 0, 0, 1])
+        state, ok = C.try_place(params, state, tr, jnp.int32(0), jnp.int32(0))
+        mask = np.asarray(C.action_mask(params, state, tr))
+        # 2 free left: job 1 (4 gpus) infeasible now
+        np.testing.assert_array_equal(mask, [0, 0, 0, 0, 0, 0, 1])
+
+
+def run_pair(trace, n_nodes, gpus_per_node, actions, queue_len, n_placements=2):
+    """Drive oracle and JAX sim with the same action sequence; compare
+    trajectories after every step."""
+    params = C.SimParams(n_nodes=n_nodes, gpus_per_node=gpus_per_node,
+                         max_jobs=trace.max_jobs, queue_len=queue_len,
+                         n_placements=n_placements)
+    osim = O.OracleSim(trace, n_nodes, gpus_per_node)
+    tr = C.Trace.from_array_trace(trace)
+    jstate = C.init_state(params, tr)
+    step = jax.jit(lambda s, a: C.rl_step(params, s, tr, a))
+    for i, a in enumerate(actions):
+        oinfo = osim.rl_step(int(a), queue_len, n_placements)
+        jstate, jinfo = step(jstate, jnp.int32(a))
+        s = C.np_state(jstate)
+        ctx = f"step {i} action {a}"
+        np.testing.assert_allclose(s.clock, osim.clock, atol=1e-3, err_msg=ctx)
+        np.testing.assert_array_equal(s.status, osim.status, err_msg=ctx)
+        np.testing.assert_allclose(s.remaining, osim.remaining, atol=1e-3,
+                                   err_msg=ctx)
+        np.testing.assert_array_equal(s.alloc, osim.alloc, err_msg=ctx)
+        np.testing.assert_array_equal(s.free, osim.free, err_msg=ctx)
+        assert bool(jinfo.placed) == oinfo["placed"], ctx
+        np.testing.assert_allclose(float(jinfo.dt), oinfo["dt"], atol=1e-3,
+                                   err_msg=ctx)
+        assert int(jinfo.in_system_before) == oinfo["in_system_before"], ctx
+        assert bool(jinfo.done) == oinfo["done"], ctx
+        if oinfo["done"]:
+            break
+    return osim, jstate, params
+
+
+class TestRLStepEquivalence:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_actions_match_oracle(self, seed):
+        rng = np.random.default_rng(seed)
+        trace = int_trace(rng, n_jobs=20, max_gpus=4, max_jobs=24)
+        queue_len, n_placements = 5, 2
+        actions = rng.integers(0, queue_len * n_placements + 1, size=400)
+        osim, jstate, params = run_pair(trace, n_nodes=3, gpus_per_node=2,
+                                        actions=actions, queue_len=queue_len)
+
+    def test_greedy_head_completes_trace_and_matches_jct(self):
+        rng = np.random.default_rng(42)
+        trace = int_trace(rng, n_jobs=15, max_gpus=4, max_jobs=16)
+        # always try queue head with pack; falls through to time advance
+        actions = [0] * 600
+        osim, jstate, params = run_pair(trace, 2, 4, actions, queue_len=4)
+        assert osim.done()
+        tr = C.Trace.from_array_trace(trace)
+        stats = C.jct_stats(jstate, tr)
+        np.testing.assert_allclose(float(stats["avg_jct"]), osim.avg_jct(),
+                                   rtol=1e-5)
+        assert int(stats["n_done"]) == 15
+
+    def test_force_place_on_empty_event_horizon(self):
+        # single job, agent always noops: the sim must force-place to
+        # guarantee progress (oracle docstring semantics).
+        trace = to_array_trace([JobRecord(0, 0.0, 5.0, 1)], max_jobs=2)
+        params = C.SimParams(1, 2, max_jobs=2, queue_len=2, n_placements=1)
+        tr = C.Trace.from_array_trace(trace)
+        state = C.init_state(params, tr)
+        noop = jnp.int32(params.n_actions - 1)
+        state, info = C.rl_step(params, state, tr, noop)   # force-place
+        assert bool(info.placed) and float(info.dt) == 0.0
+        state, info = C.rl_step(params, state, tr, noop)   # advance to done
+        assert bool(info.done) and float(state.clock) == 5.0
+
+    def test_preempt(self):
+        trace = to_array_trace([JobRecord(0, 0.0, 10.0, 2)], max_jobs=2)
+        params = C.SimParams(1, 2, max_jobs=2, queue_len=2)
+        tr = C.Trace.from_array_trace(trace)
+        state = C.init_state(params, tr)
+        state, ok = C.try_place(params, state, tr, jnp.int32(0), jnp.int32(0))
+        state = C.advance_to(state, tr, jnp.float32(4.0))
+        state, ok = C.preempt(state, jnp.int32(0), params.max_jobs)
+        assert bool(ok)
+        s = C.np_state(state)
+        assert s.status[0] == O.PENDING and s.free.sum() == 2
+        assert s.remaining[0] == 6.0
+        att = np.asarray(C.attained_service(state, tr))
+        assert att[0] == 8.0  # 4s × 2 gpus, matches oracle.attained_service
+
+
+class TestValidateTrace:
+    def test_over_capacity_raises_on_host(self):
+        trace = to_array_trace([JobRecord(0, 0.0, 5.0, 8)], max_jobs=2)
+        params = C.SimParams(1, 2, max_jobs=2, queue_len=2)
+        with pytest.raises(ValueError, match="more than the cluster"):
+            C.Trace.from_array_trace(trace, params)
+
+    def test_clamp(self):
+        trace = to_array_trace([JobRecord(0, 0.0, 5.0, 8)], max_jobs=2)
+        params = C.SimParams(1, 2, max_jobs=2, queue_len=2)
+        clamped = C.validate_trace(params, trace, clamp=True)
+        assert clamped.gpus[0] == 2
+
+    def test_over_capacity_step_does_not_lie(self):
+        # if an unvalidated over-capacity job sneaks in, rl_step must not
+        # report placed=True (regression: forced-place success flag)
+        trace = to_array_trace([JobRecord(0, 0.0, 5.0, 8)], max_jobs=2)
+        params = C.SimParams(1, 2, max_jobs=2, queue_len=2, n_placements=1)
+        tr = C.Trace.from_array_trace(trace)
+        state = C.init_state(params, tr)
+        state, info = C.rl_step(params, state, tr, jnp.int32(0))
+        assert not bool(info.placed) and not bool(info.done)
+
+
+class TestVmap:
+    def test_vmapped_step_matches_single(self):
+        rng = np.random.default_rng(0)
+        traces = [int_trace(np.random.default_rng(s), 10, 2, max_jobs=12)
+                  for s in range(4)]
+        params = C.SimParams(2, 2, max_jobs=12, queue_len=4, n_placements=1)
+        trs = [C.Trace.from_array_trace(t) for t in traces]
+        batched = jax.tree.map(lambda *xs: jnp.stack(xs), *trs)
+        states = jax.vmap(lambda tr: C.init_state(params, tr))(batched)
+        actions = jnp.asarray(rng.integers(0, params.n_actions, size=(20, 4)),
+                              jnp.int32)
+        vstep = jax.jit(jax.vmap(lambda s, tr, a: C.rl_step(params, s, tr, a)))
+        sstep = jax.jit(lambda s, tr, a: C.rl_step(params, s, tr, a))
+        single_states = [jax.tree.map(lambda x: x[i], states) for i in range(4)]
+        for t in range(20):
+            states, infos = vstep(states, batched, actions[t])
+            for i in range(4):
+                single_states[i], _ = sstep(single_states[i], trs[i], actions[t][i])
+                got = jax.tree.map(lambda x: np.asarray(x[i]), states)
+                want = C.np_state(single_states[i])
+                for g, w in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+                    np.testing.assert_allclose(g, w, atol=1e-4)
