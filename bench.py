@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Headline benchmark: PPO env-steps/sec/chip (north-star metric #1,
+BASELINE.json / SURVEY.md §6).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no number for this metric (BASELINE.json
+``published = {}``), so ``vs_baseline`` is reported against the first
+recorded value of OUR implementation (BENCH_BASELINE_VALUE below, set from
+round 1); 1.0 means parity with that record.
+
+Runs the config-1 workload (PPO-MLP, 64-GPU cluster, synthetic Poisson
+trace — SURVEY.md §0) scaled to fill one chip: the fused rollout+update
+train step is one jitted XLA program, so steps/sec measures the whole
+RL loop, not just env stepping.
+
+TPU expected; if the TPU tunnel is unhealthy (it hangs JAX init on this
+machine) we detect that with a subprocess probe and fall back to CPU,
+flagging the platform in the JSON line.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+# First recorded value on the target chip (TPU v5e). None until round 1
+# measures it; the driver's BENCH_r1.json becomes the reference point.
+BENCH_BASELINE_VALUE: float | None = None
+BENCH_BASELINE_PLATFORM = "tpu"
+
+
+def tpu_healthy(timeout_s: float = 75.0) -> bool:
+    """The axon TPU tunnel hangs JAX init when unhealthy — probe in a
+    subprocess so we can time out and fall back."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); print(d[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def cpu_env() -> dict:
+    env = {k: v for k, v in os.environ.items()
+           if "AXON" not in k and k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def main() -> None:
+    on_tpu = "--cpu" not in sys.argv and tpu_healthy()
+    if not on_tpu and os.environ.get("_BENCH_CPU") != "1":
+        # re-exec without the TPU-tunnel sitecustomize so jax can init CPU
+        env = cpu_env()
+        env["_BENCH_CPU"] = "1"
+        os.execvpe(sys.executable, [sys.executable, __file__, "--cpu"], env)
+
+    import jax
+    from rlgpuschedule_tpu.algos import PPOConfig
+    from rlgpuschedule_tpu.configs import PPO_MLP_SYNTH64
+    from rlgpuschedule_tpu.experiment import Experiment
+
+    platform = jax.devices()[0].platform
+    # scale the env batch to the platform: the TPU run is the benchmark;
+    # the CPU fallback only proves liveness
+    if platform == "cpu":
+        n_envs, n_steps, iters = 32, 64, 3
+    else:
+        n_envs, n_steps, iters = 512, 128, 5
+    cfg = dataclasses.replace(
+        PPO_MLP_SYNTH64, n_envs=n_envs,
+        ppo=PPOConfig(n_steps=n_steps, n_epochs=2, n_minibatches=8))
+    exp = Experiment.build(cfg)
+    exp.run(iterations=1)                    # compile + warmup
+    t0 = time.time()
+    exp.run(iterations=iters)
+    wall = time.time() - t0
+    steps_per_sec = iters * exp.steps_per_iteration / wall
+    n_chips = jax.device_count()
+    value = steps_per_sec / n_chips
+    vs = (value / BENCH_BASELINE_VALUE
+          if BENCH_BASELINE_VALUE and platform == BENCH_BASELINE_PLATFORM
+          else 1.0)
+    print(json.dumps({
+        "metric": f"ppo_env_steps_per_sec_per_chip[{platform}]",
+        "value": round(value, 1),
+        "unit": "env-steps/s/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

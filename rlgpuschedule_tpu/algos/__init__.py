@@ -1,0 +1,12 @@
+"""L4 RL algorithms: fused rollouts, GAE, PPO, A2C."""
+from .rollout import (Transition, RolloutCarry, PolicyApply, rollout,
+                      init_carry)
+from .ppo import (PPOConfig, PPOMetrics, make_train_step as make_ppo_step,
+                  make_train_state, ppo_loss, masked_entropy)
+from .a2c import A2CConfig, A2CMetrics, make_train_step as make_a2c_step
+
+__all__ = [
+    "Transition", "RolloutCarry", "PolicyApply", "rollout", "init_carry",
+    "PPOConfig", "PPOMetrics", "make_ppo_step", "make_train_state",
+    "ppo_loss", "masked_entropy", "A2CConfig", "A2CMetrics", "make_a2c_step",
+]

@@ -1,0 +1,93 @@
+"""A2C trainer (L4): synchronous advantage actor-critic.
+
+Capability parity: SURVEY.md §2 "A2C trainer" / config 3 — the same fused
+rollout and GAE machinery as PPO, but a single full-batch policy-gradient
+update per iteration (no ratio clipping, no minibatch epochs). Multi-actor
+parallelism is an env-batch/mesh axis, not processes: more vmapped envs per
+chip × data-parallel chips with pmean gradient sync (SURVEY.md §2
+"Multi-actor runner" rebuild form).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training.train_state import TrainState
+
+from ..env.env import EnvParams
+from ..ops.gae import compute_gae
+from .ppo import masked_entropy
+from .rollout import PolicyApply, RolloutCarry, Transition, rollout
+
+
+@dataclasses.dataclass(frozen=True)
+class A2CConfig:
+    n_steps: int = 16           # shorter rollouts, more frequent updates
+    gamma: float = 0.995
+    gae_lambda: float = 1.0     # plain n-step advantage by default
+    vf_coef: float = 0.5
+    ent_coef: float = 0.01
+    lr: float = 7e-4
+    max_grad_norm: float = 0.5
+
+
+class A2CMetrics(NamedTuple):
+    total_loss: jax.Array
+    pg_loss: jax.Array
+    v_loss: jax.Array
+    entropy: jax.Array
+    mean_reward: jax.Array
+    mean_value: jax.Array
+
+
+def make_optimizer(config: A2CConfig) -> optax.GradientTransformation:
+    return optax.chain(optax.clip_by_global_norm(config.max_grad_norm),
+                       optax.rmsprop(config.lr, decay=0.99, eps=1e-5))
+
+
+def a2c_loss(apply_fn: PolicyApply, net_params, batch: Transition,
+             advantages: jax.Array, returns: jax.Array, config: A2CConfig):
+    logits, value = apply_fn(net_params, batch.obs, batch.mask)
+    logp_all = jax.nn.log_softmax(logits)
+    log_prob = jnp.take_along_axis(logp_all, batch.action[:, None],
+                                   axis=1).squeeze(1)
+    pg_loss = -jnp.mean(log_prob * advantages)
+    v_loss = 0.5 * jnp.mean((value - returns) ** 2)
+    entropy = jnp.mean(masked_entropy(logits))
+    total = pg_loss + config.vf_coef * v_loss - config.ent_coef * entropy
+    return total, (pg_loss, v_loss, entropy)
+
+
+def make_train_step(apply_fn: PolicyApply, env_params: EnvParams,
+                    config: A2CConfig, axis_name: str | None = None):
+    """(train_state, carry, traces, key) -> (train_state', carry', metrics).
+    Action sampling draws from carry.key (advanced inside the rollout);
+    ``key`` is accepted for signature uniformity with PPO's train_step."""
+
+    def train_step(train_state: TrainState, carry: RolloutCarry, traces,
+                   key: jax.Array):
+        del key
+        carry, tr, last_value = rollout(apply_fn, train_state.params,
+                                        env_params, traces, carry,
+                                        config.n_steps)
+        advantages, returns = compute_gae(tr.reward, tr.value, tr.done,
+                                          last_value, config.gamma,
+                                          config.gae_lambda)
+        B = config.n_steps * tr.reward.shape[1]
+        flat = jax.tree.map(lambda x: x.reshape(B, *x.shape[2:]), tr)
+        (loss, (pg, vl, ent)), grads = jax.value_and_grad(
+            a2c_loss, argnums=1, has_aux=True)(
+            apply_fn, train_state.params, flat, advantages.reshape(B),
+            returns.reshape(B), config)
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+        train_state = train_state.apply_gradients(grads=grads)
+        metrics = A2CMetrics(total_loss=loss, pg_loss=pg, v_loss=vl,
+                             entropy=ent, mean_reward=jnp.mean(tr.reward),
+                             mean_value=jnp.mean(tr.value))
+        return train_state, carry, metrics
+
+    return train_step

@@ -1,0 +1,162 @@
+"""PPO trainer (L4): clipped surrogate, minibatch epochs, entropy bonus.
+
+Capability parity: SURVEY.md §2 "PPO trainer" and §3.1 — the reference's
+rollout→GAE→minibatch-update iteration, lowered end-to-end to XLA: the
+whole train step (fused rollout scan + GAE reverse scan + epoch×minibatch
+update scans) is ONE jitted function. Gradient sync for data parallelism is
+a ``lax.pmean`` over the mesh axis (``axis_name``), the TPU-native
+replacement for the reference's NCCL allreduce (SURVEY.md §2 "Distributed
+comm backend"; used under ``shard_map`` in ``parallel.dp``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training.train_state import TrainState
+
+from ..env.env import EnvParams
+from ..ops.gae import compute_gae
+from .rollout import PolicyApply, RolloutCarry, Transition, rollout
+
+
+@dataclasses.dataclass(frozen=True)
+class PPOConfig:
+    n_steps: int = 128          # rollout length T per iteration
+    n_epochs: int = 4
+    n_minibatches: int = 4
+    gamma: float = 0.995
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    vf_coef: float = 0.5
+    ent_coef: float = 0.01
+    lr: float = 3e-4
+    max_grad_norm: float = 0.5
+
+
+def make_optimizer(config: PPOConfig) -> optax.GradientTransformation:
+    return optax.chain(optax.clip_by_global_norm(config.max_grad_norm),
+                       optax.adam(config.lr, eps=1e-5))
+
+
+def masked_entropy(logits: jax.Array) -> jax.Array:
+    """Entropy of the masked categorical (−1e9 logits contribute ~0)."""
+    logp = jax.nn.log_softmax(logits)
+    p = jnp.exp(logp)
+    return -jnp.sum(p * jnp.where(p > 0, logp, 0.0), axis=-1)
+
+
+class PPOMetrics(NamedTuple):
+    total_loss: jax.Array
+    pg_loss: jax.Array
+    v_loss: jax.Array
+    entropy: jax.Array
+    approx_kl: jax.Array
+    clip_frac: jax.Array
+    mean_reward: jax.Array
+    mean_value: jax.Array
+
+
+def ppo_loss(apply_fn: PolicyApply, net_params, batch: Transition,
+             advantages: jax.Array, returns: jax.Array, config: PPOConfig):
+    logits, value = apply_fn(net_params, batch.obs, batch.mask)
+    logp_all = jax.nn.log_softmax(logits)
+    log_prob = jnp.take_along_axis(logp_all, batch.action[:, None],
+                                   axis=1).squeeze(1)
+    ratio = jnp.exp(log_prob - batch.log_prob)
+    pg1 = ratio * advantages
+    pg2 = jnp.clip(ratio, 1 - config.clip_eps, 1 + config.clip_eps) * advantages
+    pg_loss = -jnp.mean(jnp.minimum(pg1, pg2))
+    # clipped value loss (PPO2-style trust region on the critic)
+    v_clipped = batch.value + jnp.clip(value - batch.value,
+                                       -config.clip_eps, config.clip_eps)
+    v_loss = 0.5 * jnp.mean(jnp.maximum((value - returns) ** 2,
+                                        (v_clipped - returns) ** 2))
+    entropy = jnp.mean(masked_entropy(logits))
+    total = pg_loss + config.vf_coef * v_loss - config.ent_coef * entropy
+    approx_kl = jnp.mean(batch.log_prob - log_prob)
+    clip_frac = jnp.mean((jnp.abs(ratio - 1.0) > config.clip_eps)
+                         .astype(jnp.float32))
+    return total, (pg_loss, v_loss, entropy, approx_kl, clip_frac)
+
+
+def make_train_step(apply_fn: PolicyApply, env_params: EnvParams,
+                    config: PPOConfig, axis_name: str | None = None):
+    """Build the jittable PPO iteration:
+    (train_state, carry, traces, key) -> (train_state', carry', metrics).
+
+    ``axis_name``: mesh axis for data-parallel gradient pmean (None =
+    single-device)."""
+
+    def train_step(train_state: TrainState, carry: RolloutCarry, traces,
+                   key: jax.Array):
+        carry, tr, last_value = rollout(apply_fn, train_state.params,
+                                        env_params, traces, carry,
+                                        config.n_steps)
+        advantages, returns = compute_gae(tr.reward, tr.value, tr.done,
+                                          last_value, config.gamma,
+                                          config.gae_lambda)
+        # normalize advantages over the full batch (global across the mesh
+        # axis so DP replicas agree on the statistics). Global variance must
+        # be E[x²] − (E[x])² over globally-reduced moments — a pmean of
+        # per-shard variances would drop the between-shard term.
+        adv_mean = jnp.mean(advantages)
+        adv_sq = jnp.mean(advantages ** 2)
+        if axis_name is not None:
+            adv_mean = jax.lax.pmean(adv_mean, axis_name)
+            adv_sq = jax.lax.pmean(adv_sq, axis_name)
+        adv_var = adv_sq - adv_mean ** 2
+        advantages = (advantages - adv_mean) / jnp.sqrt(adv_var + 1e-8)
+
+        B = config.n_steps * tr.reward.shape[1]
+        flat = jax.tree.map(lambda x: x.reshape(B, *x.shape[2:]), tr)
+        adv_flat = advantages.reshape(B)
+        ret_flat = returns.reshape(B)
+        mb_size = B // config.n_minibatches
+        assert mb_size * config.n_minibatches == B, \
+            "n_steps * n_envs must be divisible by n_minibatches"
+
+        def epoch(state_and_key, _):
+            state, key = state_and_key
+            key, sub = jax.random.split(key)
+            perm = jax.random.permutation(sub, B)
+            mb_idx = perm.reshape(config.n_minibatches, mb_size)
+
+            def minibatch(state, idx):
+                mb = jax.tree.map(lambda x: x[idx], flat)
+                (loss, aux), grads = jax.value_and_grad(
+                    ppo_loss, argnums=1, has_aux=True)(
+                    apply_fn, state.params, mb, adv_flat[idx], ret_flat[idx],
+                    config)
+                if axis_name is not None:
+                    grads = jax.lax.pmean(grads, axis_name)
+                state = state.apply_gradients(grads=grads)
+                return state, (loss, *aux)
+
+            state, stats = jax.lax.scan(minibatch, state, mb_idx)
+            return (state, key), stats
+
+        (train_state, _), stats = jax.lax.scan(
+            epoch, (train_state, key), None, length=config.n_epochs)
+        mean = lambda x: jnp.mean(x)
+        metrics = PPOMetrics(
+            total_loss=mean(stats[0]), pg_loss=mean(stats[1]),
+            v_loss=mean(stats[2]), entropy=mean(stats[3]),
+            approx_kl=mean(stats[4]), clip_frac=mean(stats[5]),
+            mean_reward=jnp.mean(tr.reward), mean_value=jnp.mean(tr.value))
+        return train_state, carry, metrics
+
+    return train_step
+
+
+def make_train_state(net, key: jax.Array, example_obs: jax.Array,
+                     example_mask: jax.Array,
+                     tx: optax.GradientTransformation,
+                     extra_apply_args: tuple = ()) -> TrainState:
+    """Initialize params + optimizer into a flax TrainState.
+    ``extra_apply_args`` go between obs and mask (the GNN's adjacency)."""
+    params = net.init(key, example_obs, *extra_apply_args, example_mask)
+    return TrainState.create(apply_fn=net.apply, params=params, tx=tx)

@@ -1,0 +1,70 @@
+"""Fused policy+env rollout collection (L4).
+
+Capability parity: SURVEY.md §2 "Rollout buffer" / "Multi-actor runner" and
+§3.1 HOT LOOP #1. The reference alternates host-side env stepping with
+device policy inference per step; here the policy forward, action sampling,
+and the vmapped env step fuse into ONE ``lax.scan`` that never leaves the
+device — the Podracer/Anakin pattern (SURVEY.md §7 step 5 `[P: Podracer]`),
+which removes the per-step host↔device sync that bottlenecks the reference
+(SURVEY.md §7 hard part (d)).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..env import env as env_lib
+from ..env.env import EnvParams, EnvState, TimeStep
+
+# (net_params, obs[E,...], mask[E,A]) -> (masked_logits[E,A], value[E])
+PolicyApply = Callable[[Any, jax.Array, jax.Array],
+                       tuple[jax.Array, jax.Array]]
+
+
+class Transition(NamedTuple):
+    """One scan slice of the rollout buffer; stacked to [T, E, ...]."""
+    obs: jax.Array
+    action: jax.Array
+    log_prob: jax.Array
+    value: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    mask: jax.Array
+    env_steps_dt: jax.Array  # simulated seconds advanced (metrics)
+
+
+class RolloutCarry(NamedTuple):
+    env_state: EnvState
+    obs: jax.Array
+    mask: jax.Array
+    key: jax.Array
+
+
+def init_carry(params: EnvParams, traces, key: jax.Array) -> RolloutCarry:
+    env_state, ts = env_lib.vec_reset(params, traces)
+    return RolloutCarry(env_state, ts.obs, ts.action_mask, key)
+
+
+def rollout(apply_fn: PolicyApply, net_params, env_params: EnvParams,
+            traces, carry: RolloutCarry, n_steps: int,
+            ) -> tuple[RolloutCarry, Transition, jax.Array]:
+    """Collect ``n_steps`` transitions from the vectorized envs in one scan.
+    Returns (carry', transitions [T,E,...], last_value [E])."""
+
+    def step(c: RolloutCarry, _):
+        logits, value = apply_fn(net_params, c.obs, c.mask)
+        key, sub = jax.random.split(c.key)
+        action = jax.random.categorical(sub, logits)
+        log_prob = jnp.take_along_axis(
+            jax.nn.log_softmax(logits), action[:, None], axis=1).squeeze(1)
+        env_state, ts = env_lib.vec_step(env_params, c.env_state, traces, action)
+        t = Transition(obs=c.obs, action=action, log_prob=log_prob,
+                       value=value, reward=ts.reward, done=ts.done,
+                       mask=c.mask, env_steps_dt=ts.info.dt)
+        return RolloutCarry(env_state, ts.obs, ts.action_mask, key), t
+
+    carry, transitions = jax.lax.scan(step, carry, None, length=n_steps)
+    _, last_value = apply_fn(net_params, carry.obs, carry.mask)
+    return carry, transitions, last_value

@@ -1,0 +1,88 @@
+"""Named experiment configs (L6).
+
+Capability parity: SURVEY.md §2 "Config/flags" and §0 — dataclass configs
+with named presets matching the five driver-specified capability configs
+exactly (SURVEY.md §5 "Config / flag system").
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Literal
+
+from .algos.a2c import A2CConfig
+from .algos.ppo import PPOConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    name: str
+    algo: Literal["ppo", "a2c"] = "ppo"
+    # cluster
+    n_nodes: int = 8
+    gpus_per_node: int = 8
+    # trace source
+    trace: Literal["synthetic", "philly", "pai"] = "synthetic"
+    trace_path: str | None = None
+    arrival_rate: float = 0.08          # synthetic: jobs/sec
+    mean_duration: float = 600.0        # synthetic: log-normal mean
+    window_jobs: int = 64               # jobs per episode window (max_jobs)
+    # env
+    n_envs: int = 4
+    queue_len: int = 8
+    n_placements: int = 1
+    obs_kind: Literal["flat", "grid", "graph"] = "flat"
+    reward_kind: Literal["jct", "fair"] = "jct"
+    n_tenants: int = 1
+    nodes_per_rack: int | None = None   # graph topology granularity
+    horizon: int = 512
+    time_scale: float = 600.0
+    reward_scale: float = 10_000.0
+    # training
+    ppo: PPOConfig = PPOConfig()
+    a2c: A2CConfig = A2CConfig()
+    iterations: int = 100
+    seed: int = 0
+
+    @property
+    def total_gpus(self) -> int:
+        return self.n_nodes * self.gpus_per_node
+
+
+# The five driver-specified capability configs (SURVEY.md §0, `[B]`).
+CONFIGS: dict[str, ExperimentConfig] = {}
+
+
+def _register(cfg: ExperimentConfig) -> ExperimentConfig:
+    CONFIGS[cfg.name] = cfg
+    return cfg
+
+
+# 1. PPO-MLP scheduler, 64-GPU synthetic Poisson trace, 4 vectorized envs.
+PPO_MLP_SYNTH64 = _register(ExperimentConfig(
+    name="ppo-mlp-synth64", algo="ppo", n_nodes=8, gpus_per_node=8,
+    trace="synthetic", n_envs=4, obs_kind="flat"))
+
+# 2. PPO-CNN on Microsoft Philly trace, 512-GPU simulated cluster.
+PPO_CNN_PHILLY512 = _register(ExperimentConfig(
+    name="ppo-cnn-philly512", algo="ppo", n_nodes=64, gpus_per_node=8,
+    trace="philly", n_envs=8, obs_kind="grid", window_jobs=128,
+    queue_len=16, horizon=1024))
+
+# 3. A2C multi-actor on Alibaba PAI trace, multi-tenant fairness reward.
+A2C_PAI_FAIR = _register(ExperimentConfig(
+    name="a2c-pai-fair", algo="a2c", n_nodes=16, gpus_per_node=8,
+    trace="pai", n_envs=16, obs_kind="flat", reward_kind="fair",
+    n_tenants=8, window_jobs=96))
+
+# 4. GNN policy over cluster topology, gang-scheduling + placement actions.
+GNN_GANG_PLACE = _register(ExperimentConfig(
+    name="gnn-gang-place", algo="ppo", n_nodes=16, gpus_per_node=8,
+    trace="synthetic", n_envs=4, obs_kind="graph", n_placements=2,
+    nodes_per_rack=4, window_jobs=64))
+
+# 5. Hierarchical multi-agent across 4 pods + PBT: this is the per-member
+# training config that the population/hierarchy machinery (parallel/) runs
+# many copies of.
+HIER_PBT_MEMBER = _register(ExperimentConfig(
+    name="hier-pbt-member", algo="ppo", n_nodes=8, gpus_per_node=8,
+    trace="synthetic", n_envs=4, obs_kind="flat", window_jobs=64))

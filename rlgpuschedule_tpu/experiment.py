@@ -1,0 +1,153 @@
+"""Experiment assembly (L6): config -> traces + env + policy + train loop.
+
+Capability parity: SURVEY.md §3.1 — the `train()` call stack: build trace,
+make vectorized envs, build policy, run the trainer loop, log metrics.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .algos import (A2CConfig, PPOConfig, init_carry, make_a2c_step,
+                    make_ppo_step, make_train_state)
+from .algos.ppo import make_optimizer
+from .configs import ExperimentConfig
+from .env import EnvParams, build_adjacency, stack_traces
+from .env import env as env_lib
+from .models import make_policy
+from .sim.core import SimParams
+from .traces import (ArrayTrace, gen_poisson_trace, load_pai, load_philly)
+from flax.training.train_state import TrainState
+
+
+def build_env_params(cfg: ExperimentConfig) -> EnvParams:
+    sim = SimParams(n_nodes=cfg.n_nodes, gpus_per_node=cfg.gpus_per_node,
+                    max_jobs=cfg.window_jobs, queue_len=cfg.queue_len,
+                    n_placements=cfg.n_placements)
+    return EnvParams(sim=sim, obs_kind=cfg.obs_kind,
+                     reward_kind=cfg.reward_kind, n_tenants=cfg.n_tenants,
+                     time_scale=cfg.time_scale, reward_scale=cfg.reward_scale,
+                     horizon=cfg.horizon)
+
+
+def load_source_trace(cfg: ExperimentConfig, n_jobs: int | None = None,
+                      seed: int | None = None) -> ArrayTrace:
+    """The full source trace this experiment schedules."""
+    seed = cfg.seed if seed is None else seed
+    if cfg.trace == "synthetic":
+        n = n_jobs or max(cfg.window_jobs * max(cfg.n_envs, 8), 1024)
+        return gen_poisson_trace(cfg.arrival_rate, n, seed,
+                                 mean_duration=cfg.mean_duration,
+                                 n_tenants=max(cfg.n_tenants, 1))
+    if cfg.trace_path is None:
+        raise ValueError(
+            f"config {cfg.name!r} uses trace={cfg.trace!r} but has no "
+            f"trace_path; pass one (CSV) or use trace='synthetic'")
+    loader = load_philly if cfg.trace == "philly" else load_pai
+    return loader(cfg.trace_path, max_jobs=n_jobs)
+
+
+def make_env_windows(cfg: ExperimentConfig, source: ArrayTrace,
+                     start: int = 0) -> list[ArrayTrace]:
+    """Cut n_envs consecutive episode windows out of the source trace,
+    wrapping around if the trace is short. Windows are demand-clamped by
+    stack_traces at upload."""
+    total = source.num_jobs
+    if total < cfg.window_jobs:
+        raise ValueError(f"source trace has {total} jobs < window "
+                         f"{cfg.window_jobs}")
+    windows = []
+    for e in range(cfg.n_envs):
+        off = (start + e * cfg.window_jobs) % max(total - cfg.window_jobs + 1, 1)
+        windows.append(source.slice(off, cfg.window_jobs))
+    return windows
+
+
+@dataclasses.dataclass
+class Experiment:
+    """Assembled experiment: jitted train step + host loop."""
+    cfg: ExperimentConfig
+    env_params: EnvParams
+    traces: Any              # batched device Trace [E, ...]
+    net: Any
+    apply_fn: Callable
+    train_state: TrainState
+    train_step: Callable     # jitted
+    carry: Any
+    key: jax.Array
+
+    @staticmethod
+    def build(cfg: ExperimentConfig, axis_name: str | None = None,
+              jit: bool = True) -> "Experiment":
+        env_params = build_env_params(cfg)
+        source = load_source_trace(cfg)
+        from .sim.core import validate_trace
+        source = validate_trace(env_params.sim, source, clamp=True)
+        traces = stack_traces(make_env_windows(cfg, source), env_params)
+
+        net = make_policy(cfg.obs_kind, env_params.n_actions,
+                          n_cluster_nodes=cfg.n_nodes,
+                          queue_len=cfg.queue_len,
+                          n_placements=cfg.n_placements)
+        if cfg.obs_kind == "graph":
+            adj = jnp.asarray(build_adjacency(cfg.n_nodes, cfg.queue_len,
+                                              cfg.nodes_per_rack))
+            apply_fn = lambda p, obs, mask: net.apply(p, obs, adj, mask)
+            extra = (adj,)
+        else:
+            apply_fn = lambda p, obs, mask: net.apply(p, obs, mask)
+            extra = ()
+
+        key = jax.random.PRNGKey(cfg.seed)
+        key, init_key, carry_key = jax.random.split(key, 3)
+        _, ts0 = env_lib.vec_reset(env_params, traces)
+        algo_cfg = cfg.ppo if cfg.algo == "ppo" else cfg.a2c
+        if cfg.algo == "ppo":
+            tx = make_optimizer(algo_cfg)
+            step_fn = make_ppo_step(apply_fn, env_params, algo_cfg, axis_name)
+        else:
+            from .algos.a2c import make_optimizer as a2c_opt
+            tx = a2c_opt(algo_cfg)
+            step_fn = make_a2c_step(apply_fn, env_params, algo_cfg, axis_name)
+        train_state = make_train_state(net, init_key, ts0.obs[:1],
+                                       ts0.action_mask[:1], tx, extra)
+        carry = init_carry(env_params, traces, carry_key)
+        if jit:
+            # state and carry are replaced every iteration in run(), so
+            # donating them halves live copies in the benchmarked hot loop
+            step_fn = jax.jit(step_fn, donate_argnums=(0, 1))
+        return Experiment(cfg=cfg, env_params=env_params, traces=traces,
+                          net=net, apply_fn=apply_fn, train_state=train_state,
+                          train_step=step_fn, carry=carry, key=key)
+
+    @property
+    def steps_per_iteration(self) -> int:
+        algo_cfg = self.cfg.ppo if self.cfg.algo == "ppo" else self.cfg.a2c
+        return algo_cfg.n_steps * self.cfg.n_envs
+
+    def run(self, iterations: int | None = None, log_every: int = 0,
+            logger: Callable[[int, dict], None] | None = None) -> dict:
+        """Run the host training loop; returns summary metrics."""
+        iterations = iterations or self.cfg.iterations
+        history = []
+        t0 = time.time()
+        for i in range(iterations):
+            self.key, sub = jax.random.split(self.key)
+            self.train_state, self.carry, metrics = self.train_step(
+                self.train_state, self.carry, self.traces, sub)
+            if log_every and (i % log_every == 0 or i == iterations - 1):
+                m = {k: float(v) for k, v in metrics._asdict().items()}
+                history.append({"iteration": i, **m})
+                if logger is not None:
+                    logger(i, m)
+        jax.block_until_ready(self.train_state.params)
+        wall = time.time() - t0
+        total_env_steps = iterations * self.steps_per_iteration
+        return {"wall_s": wall, "iterations": iterations,
+                "env_steps": total_env_steps,
+                "env_steps_per_sec": total_env_steps / wall,
+                "history": history}

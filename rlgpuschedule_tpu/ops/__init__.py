@@ -1,0 +1,4 @@
+"""Reusable XLA-lowered ops (GAE, masked distributions)."""
+from .gae import compute_gae
+
+__all__ = ["compute_gae"]

@@ -1,0 +1,37 @@
+"""Generalized advantage estimation as a reverse lax.scan (L4 op).
+
+Capability parity: SURVEY.md §2 "GAE". The reference computes GAE in a
+Python loop over the buffer; here it lowers to one XLA scan over time
+(the hardware-efficient formulation — cf. the HEPPO-GAE line of work,
+SURVEY.md §7 step 5 `[P]`), fused into the jitted update.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def compute_gae(rewards: jax.Array, values: jax.Array, dones: jax.Array,
+                last_value: jax.Array, gamma: float, lam: float,
+                ) -> tuple[jax.Array, jax.Array]:
+    """Returns (advantages, returns), each [T, ...].
+
+    Args:
+      rewards: [T, ...] reward at each step.
+      values:  [T, ...] value estimate of the state the action was taken in.
+      dones:   [T, ...] episode ended AT this step (auto-reset envs: the
+               next state belongs to a fresh episode — no bootstrap across).
+      last_value: [...] value of the state after the final step.
+    """
+    def step(next_adv_and_v, x):
+        next_adv, next_v = next_adv_and_v
+        r, v, d = x
+        nonterm = 1.0 - d
+        delta = r + gamma * next_v * nonterm - v
+        adv = delta + gamma * lam * nonterm * next_adv
+        return (adv, v), adv
+
+    (_, _), advantages = jax.lax.scan(
+        step, (jnp.zeros_like(last_value), last_value),
+        (rewards, values, dones.astype(rewards.dtype)), reverse=True)
+    return advantages, advantages + values

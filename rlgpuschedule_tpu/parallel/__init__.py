@@ -1,0 +1,10 @@
+"""L5 distributed execution: device mesh + data-parallel training."""
+from .mesh import (make_mesh, replicated, env_sharded, pop_sharded,
+                   pop_env_sharded, DATA_AXIS, POP_AXIS)
+from .dp import shard_train, carry_sharding_prefix, put_carry
+
+__all__ = [
+    "make_mesh", "replicated", "env_sharded", "pop_sharded",
+    "pop_env_sharded", "DATA_AXIS", "POP_AXIS",
+    "shard_train", "carry_sharding_prefix", "put_carry",
+]

@@ -1,0 +1,67 @@
+"""Data-parallel training over a device mesh (L5).
+
+Capability parity: SURVEY.md §2 "Distributed comm backend" / §7 step 6 —
+the reference's actor-learner gradient sync (NCCL allreduce driven from
+torch.distributed) becomes sharding annotations on ONE jitted train step:
+
+- params / optimizer state: replicated (P()),
+- env batch (traces, rollout carry): sharded over the ``data`` mesh axis,
+- GSPMD auto-partitions the fused rollout scan over local env shards and
+  inserts the gradient all-reduce (psum over ICI) where sharded-batch
+  gradients meet replicated params — the TPU-native replacement for the
+  reference's hand-driven NCCL calls.
+
+The rollout carry's PRNG key is replicated: per-env action sampling is
+already independent per batch row, so replicas compute identical updates
+(replicated-param invariance is asserted in tests/test_parallel.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+from jax.sharding import Mesh
+
+from ..algos.rollout import RolloutCarry
+from .mesh import DATA_AXIS, env_sharded, replicated
+
+
+def carry_sharding_prefix(mesh: Mesh) -> RolloutCarry:
+    """RolloutCarry sharding prefix-tree: PRNG key replicated, everything
+    env-batched split over ``data``."""
+    env = env_sharded(mesh)
+    return RolloutCarry(env_state=env, obs=env, mask=env,
+                        key=replicated(mesh))
+
+
+def put_carry(mesh: Mesh, carry: RolloutCarry) -> RolloutCarry:
+    env = env_sharded(mesh)
+    return RolloutCarry(
+        env_state=jax.device_put(carry.env_state, env),
+        obs=jax.device_put(carry.obs, env),
+        mask=jax.device_put(carry.mask, env),
+        key=jax.device_put(carry.key, replicated(mesh)))
+
+
+def shard_train(mesh: Mesh, train_step: Callable, train_state, carry,
+                traces) -> tuple[Callable, Any, RolloutCarry, Any]:
+    """Place (state, carry, traces) on the mesh and wrap ``train_step``
+    (an UNjitted step from algos.ppo/a2c, axis_name=None) in a jit with
+    explicit in/out shardings. Returns (jitted_step, state, carry, traces)
+    for the host loop. n_envs must be divisible by the ``data`` axis."""
+    n_data = mesh.shape[DATA_AXIS]
+    n_envs = int(traces.submit.shape[0])
+    if n_envs % n_data != 0:
+        raise ValueError(f"n_envs={n_envs} not divisible by data axis "
+                         f"size {n_data}")
+    env = env_sharded(mesh)
+    rep = replicated(mesh)
+    carry_sh = carry_sharding_prefix(mesh)
+    jitted = jax.jit(train_step,
+                     in_shardings=(rep, carry_sh, env, rep),
+                     out_shardings=(rep, carry_sh, rep),
+                     donate_argnums=(0, 1))
+    return (jitted,
+            jax.device_put(train_state, rep),
+            put_carry(mesh, carry),
+            jax.device_put(traces, env))

@@ -1,0 +1,61 @@
+"""Device-mesh construction and sharding helpers (L5).
+
+Capability parity: SURVEY.md §2 "Distributed comm backend" — the
+reference's NCCL process groups become a `jax.sharding.Mesh`; collectives
+are inserted by XLA (GSPMD) from sharding annotations and ride ICI within
+a slice (SURVEY.md §5 "Distributed communication backend"). Axes:
+
+- ``data``: env-batch / gradient data parallelism (allreduce → psum).
+- ``pop``:  population members (PBT) / pods (hierarchical config 5);
+  laid out on the *outer* mesh dim so cross-member traffic (rare:
+  exploit/explore weight copies) maps to the slower links and the
+  per-step gradient psum stays on the inner, fastest ICI loop.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+POP_AXIS = "pop"
+
+
+def make_mesh(n_devices: int | None = None, n_pop: int = 1,
+              devices=None) -> Mesh:
+    """(pop, data) mesh over the available devices. ``n_pop`` must divide
+    the device count; n_pop=1 is plain data parallelism."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % n_pop != 0:
+        raise ValueError(f"{n} devices not divisible by n_pop={n_pop}")
+    arr = np.asarray(devices).reshape(n_pop, n // n_pop)
+    return Mesh(arr, (POP_AXIS, DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def env_sharded(mesh: Mesh) -> NamedSharding:
+    """Leading env axis split over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def pop_sharded(mesh: Mesh) -> NamedSharding:
+    """Leading population axis split over the pop axis."""
+    return NamedSharding(mesh, P(POP_AXIS))
+
+
+def pop_env_sharded(mesh: Mesh) -> NamedSharding:
+    """[pop, env, ...] arrays: population × env-batch."""
+    return NamedSharding(mesh, P(POP_AXIS, DATA_AXIS))
+
+
+def put(tree: Any, sharding: NamedSharding) -> Any:
+    """device_put a whole pytree under one sharding."""
+    return jax.device_put(tree, sharding)

@@ -118,7 +118,10 @@ def init_state(params: SimParams, trace: Trace) -> SimState:
     state = SimState(
         clock=jnp.float32(0.0),
         status=jnp.where(trace.valid, NOT_ARRIVED, DONE).astype(jnp.int32),
-        remaining=trace.duration.astype(jnp.float32),
+        # copy=True: .astype on an already-f32 array aliases the trace
+        # buffer, and a donated sim state must never share buffers with the
+        # (non-donated) trace — XLA rejects `f(donate(a), a)`
+        remaining=jnp.array(trace.duration, jnp.float32, copy=True),
         start=jnp.full((J,), INF, jnp.float32),
         finish=jnp.full((J,), INF, jnp.float32),
         alloc=jnp.zeros((J, N), jnp.int32),

@@ -1,0 +1,61 @@
+"""Experiment assembly tests: the five named configs build; config 1 trains
+(SURVEY.md §7 step 5 milestone)."""
+import numpy as np
+import pytest
+
+import dataclasses
+
+from rlgpuschedule_tpu.configs import CONFIGS, ExperimentConfig
+from rlgpuschedule_tpu.experiment import (Experiment, build_env_params,
+                                          load_source_trace, make_env_windows)
+from rlgpuschedule_tpu.algos import PPOConfig, A2CConfig
+
+
+def small(cfg: ExperimentConfig, **kw) -> ExperimentConfig:
+    """Shrink a preset for CPU testing."""
+    return dataclasses.replace(
+        cfg, n_envs=2, window_jobs=16, horizon=64, iterations=2,
+        ppo=PPOConfig(n_steps=8, n_epochs=1, n_minibatches=2),
+        a2c=A2CConfig(n_steps=8), **kw)
+
+
+class TestConfigs:
+    def test_five_presets_registered(self):
+        assert set(CONFIGS) == {"ppo-mlp-synth64", "ppo-cnn-philly512",
+                                "a2c-pai-fair", "gnn-gang-place",
+                                "hier-pbt-member"}
+        assert CONFIGS["ppo-mlp-synth64"].total_gpus == 64
+        assert CONFIGS["ppo-cnn-philly512"].total_gpus == 512
+
+    def test_real_trace_configs_require_path(self):
+        with pytest.raises(ValueError, match="trace_path"):
+            load_source_trace(CONFIGS["ppo-cnn-philly512"])
+
+    def test_windows_cut_and_rebase(self):
+        cfg = small(CONFIGS["ppo-mlp-synth64"])
+        src = load_source_trace(cfg)
+        wins = make_env_windows(cfg, src)
+        assert len(wins) == cfg.n_envs
+        for w in wins:
+            assert w.num_jobs == cfg.window_jobs
+            assert w.submit[0] == 0.0
+
+
+class TestExperimentRuns:
+    @pytest.mark.parametrize("name", ["ppo-mlp-synth64", "gnn-gang-place",
+                                      "a2c-pai-fair", "hier-pbt-member"])
+    def test_build_and_train_two_iterations(self, name):
+        cfg = small(CONFIGS[name])
+        if cfg.trace != "synthetic":  # pai config: use synthetic source in CI
+            cfg = dataclasses.replace(cfg, trace="synthetic")
+        exp = Experiment.build(cfg)
+        out = exp.run(iterations=2, log_every=1)
+        assert out["env_steps"] == 2 * exp.steps_per_iteration
+        assert all(np.isfinite(list(h.values())).all() for h in out["history"])
+
+    def test_grid_config_small(self):
+        cfg = small(CONFIGS["ppo-cnn-philly512"], trace="synthetic",
+                    n_nodes=8, queue_len=4)
+        exp = Experiment.build(cfg)
+        out = exp.run(iterations=2)
+        assert out["env_steps_per_sec"] > 0

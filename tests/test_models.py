@@ -1,0 +1,69 @@
+"""Model tests: encoder/head shapes, dtype, action masking (SURVEY.md §4)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from rlgpuschedule_tpu.models import (ActorCritic, CNNEncoder, GNNActorCritic,
+                                      GNNEncoder, MLPEncoder, make_policy,
+                                      NEG_INF)
+from rlgpuschedule_tpu.env import build_adjacency
+
+
+class TestActorCritic:
+    def test_mlp_shapes_and_masking(self):
+        net = ActorCritic(MLPEncoder(features=(32,)), n_actions=5)
+        obs = jnp.ones((3, 10))
+        mask = jnp.array([[1, 1, 0, 0, 1]] * 3, bool)
+        params = net.init(jax.random.PRNGKey(0), obs, mask)
+        logits, value = net.apply(params, obs, mask)
+        assert logits.shape == (3, 5) and value.shape == (3,)
+        assert logits.dtype == jnp.float32 and value.dtype == jnp.float32
+        got = np.asarray(logits)
+        assert (got[:, 2] <= NEG_INF).all() and (got[:, 3] <= NEG_INF).all()
+        # masked actions are never sampled
+        samples = jax.random.categorical(jax.random.PRNGKey(1), logits,
+                                         shape=(3,))
+        assert all(int(s) in (0, 1, 4) for s in samples)
+
+    def test_cnn_shapes(self):
+        net = ActorCritic(CNNEncoder(features=(8, 8), dense=32), n_actions=7)
+        obs = jnp.ones((2, 12, 8, 2))
+        mask = jnp.ones((2, 7), bool)
+        params = net.init(jax.random.PRNGKey(0), obs, mask)
+        logits, value = net.apply(params, obs, mask)
+        assert logits.shape == (2, 7) and value.shape == (2,)
+
+    def test_gnn_shapes_factored_actions(self):
+        N, K, P = 4, 3, 2
+        adj = jnp.asarray(build_adjacency(N, K))
+        net = GNNActorCritic(GNNEncoder(features=(16, 16)), N, K, P)
+        obs = jnp.ones((2, N + K, 5))
+        mask = jnp.ones((2, K * P + 1), bool)
+        params = net.init(jax.random.PRNGKey(0), obs, adj, mask)
+        logits, value = net.apply(params, obs, adj, mask)
+        assert logits.shape == (2, K * P + 1) and value.shape == (2,)
+
+    def test_gnn_slot_logits_follow_slot_features(self):
+        # per-slot head: permuting queue-slot features permutes slot logits
+        N, K = 2, 3
+        adj = jnp.asarray(build_adjacency(N, K))
+        net = GNNActorCritic(GNNEncoder(features=(16,)), N, K, 1)
+        key = jax.random.PRNGKey(0)
+        obs = jax.random.normal(key, (1, N + K, 5))
+        mask = jnp.ones((1, K + 1), bool)
+        params = net.init(key, obs, adj, mask)
+        logits, _ = net.apply(params, obs, adj, mask)
+        perm = [1, 2, 0]
+        obs_p = obs.at[0, N:].set(obs[0, N:][jnp.asarray(perm)])
+        logits_p, _ = net.apply(params, obs_p, adj, mask)
+        np.testing.assert_allclose(np.asarray(logits_p[0, :K]),
+                                   np.asarray(logits[0, :K])[perm], atol=1e-5)
+
+    def test_make_policy_factory(self):
+        assert isinstance(make_policy("flat", 5), ActorCritic)
+        assert isinstance(make_policy("grid", 5), ActorCritic)
+        assert isinstance(make_policy("graph", 5, n_cluster_nodes=2,
+                                      queue_len=2), GNNActorCritic)
+        with pytest.raises(ValueError):
+            make_policy("bogus", 5)
