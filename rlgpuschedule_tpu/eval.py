@@ -1,0 +1,192 @@
+"""Evaluation / trace replay (L6): policy JCT vs baseline schedulers.
+
+Capability parity: SURVEY.md §3.4 — "run trained policy (or baseline) over
+full trace, report JCT table" — the harness behind north-star metric #2
+(avg JCT on the Philly trace vs Tiresias, SURVEY.md §0/§6).
+
+The policy side is a deterministic (greedy-argmax) replay of the jitted
+environment: one ``lax.scan`` per window batch, frozen per-env once the
+episode completes, so the whole evaluation is a single XLA program. The
+baseline side replays the same windows through the oracle event-driven sim
+(``sim.schedulers``), giving an apples-to-apples avg-JCT table.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .env import env as env_lib
+from .env.env import EnvParams
+from .sim import core
+from .sim.oracle import OracleSim
+from .sim.schedulers import BASELINES, run_scheduler
+from .traces.records import ArrayTrace
+
+
+class EvalResult(NamedTuple):
+    """Per-window-batch evaluation outcome (device arrays, [E] leading)."""
+    avg_jct: jax.Array      # f32[E] mean JCT over completed jobs
+    n_done: jax.Array       # i32[E] completed valid jobs
+    n_valid: jax.Array      # i32[E] valid jobs in the window
+    makespan: jax.Array     # f32[E] final sim clock
+    utilization: jax.Array  # f32[E] time-averaged GPU busy fraction
+    steps: jax.Array        # i32[E] decision steps taken
+
+
+def _greedy_actions(logits: jax.Array) -> jax.Array:
+    return jnp.argmax(logits, axis=-1)
+
+
+def _random_actions(key: jax.Array, mask: jax.Array) -> jax.Array:
+    logits = jnp.where(mask, 0.0, -1e9)
+    return jax.random.categorical(key, logits)
+
+
+def replay(apply_fn: Callable, net_params: Any, env_params: EnvParams,
+           traces: core.Trace, max_steps: int | None = None,
+           policy: str = "greedy", key: jax.Array | None = None,
+           ) -> EvalResult:
+    """Deterministically replay the batched trace windows under the policy.
+
+    Unlike training rollouts there is NO auto-reset: each env runs its
+    window to completion (or ``max_steps``) and is then frozen — the scan
+    keeps stepping the other envs, masking out the finished ones, which is
+    the static-shape replacement for the oracle's per-window event loop.
+
+    ``policy``: "greedy" (argmax over masked logits — deterministic replay,
+    SURVEY.md §3.4) or "random" (masked-uniform; the learning-smoke-test
+    baseline, SURVEY.md §4 "policy beats random").
+    """
+    max_steps = int(max_steps or env_params.horizon)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    state, ts = env_lib.vec_reset(env_params, traces)
+
+    step_one = jax.vmap(lambda s, tr, a: env_lib.step(env_params, s, tr, a))
+    # time-integrated busy GPUs for time-averaged utilization
+    n_gpus = env_params.sim.capacity
+
+    def scan_step(carry, k):
+        state, obs, mask, done, busy_time = carry
+        if policy == "random":
+            actions = _random_actions(k, mask)
+        else:
+            logits, _ = apply_fn(net_params, obs, mask)
+            actions = _greedy_actions(logits)
+        new_state, new_ts = step_one(state, traces, actions)
+        dt = jnp.where(done, 0.0, new_ts.info.dt)
+        busy = jnp.sum(state.sim.alloc, axis=(1, 2)).astype(jnp.float32)
+        busy_time = busy_time + busy * dt
+        # freeze finished envs: keep the old state/obs/mask once done
+        keep = lambda old, new: jnp.where(
+            done.reshape((-1,) + (1,) * (new.ndim - 1)), old, new)
+        state = jax.tree.map(lambda o, n: keep(o, n), state, new_state)
+        obs = keep(obs, new_ts.obs)
+        mask = keep(mask, new_ts.action_mask)
+        done = done | new_ts.done
+        return (state, obs, mask, done, busy_time), None
+
+    keys = jax.random.split(key, max_steps)
+    init = (state, ts.obs, ts.action_mask,
+            jnp.zeros(ts.done.shape, bool), jnp.zeros(ts.done.shape, jnp.float32))
+    (state, _, _, done, busy_time), _ = jax.lax.scan(scan_step, init, keys)
+
+    stats = jax.vmap(lambda s, tr: core.jct_stats(s, tr))(state.sim, traces)
+    makespan = state.sim.clock
+    util = busy_time / (jnp.maximum(makespan, 1e-6) * n_gpus)
+    return EvalResult(avg_jct=stats["avg_jct"],
+                      n_done=stats["n_done"].astype(jnp.int32),
+                      n_valid=jnp.sum(traces.valid, axis=1).astype(jnp.int32),
+                      makespan=makespan, utilization=util,
+                      steps=state.t)
+
+
+def pooled_avg_jct(result: EvalResult) -> tuple[float, float]:
+    """Completion-weighted mean JCT across windows + completed fraction."""
+    n = np.asarray(result.n_done, np.float64)
+    jct = np.asarray(result.avg_jct, np.float64)
+    total = n.sum()
+    frac = float(total / max(np.asarray(result.n_valid).sum(), 1))
+    return float((jct * n).sum() / max(total, 1.0)), frac
+
+
+def baseline_jct_table(windows: list[ArrayTrace], n_nodes: int,
+                       gpus_per_node: int,
+                       names: tuple[str, ...] = ("fifo", "sjf", "srtf",
+                                                 "tiresias"),
+                       ) -> dict[str, float]:
+    """Completion-weighted avg JCT per baseline over the same windows the
+    policy is evaluated on (oracle event-driven replay, SURVEY.md §3.4)."""
+    out: dict[str, float] = {}
+    for name in names:
+        tot_jct, tot_n = 0.0, 0
+        for w in windows:
+            sim = OracleSim(w, n_nodes, gpus_per_node)
+            run_scheduler(sim, BASELINES[name]())
+            n = sum(1 for j in range(w.max_jobs)
+                    if w.valid[j] and np.isfinite(sim.finish[j]))
+            tot_jct += sim.avg_jct() * n
+            tot_n += n
+        out[name] = tot_jct / max(tot_n, 1)
+    return out
+
+
+def jct_report(exp, windows: list[ArrayTrace] | None = None,
+               max_steps: int | None = None,
+               baselines: tuple[str, ...] = ("fifo", "sjf", "srtf",
+                                             "tiresias"),
+               include_random: bool = True) -> dict[str, Any]:
+    """The full comparison table for an assembled Experiment: trained-policy
+    greedy replay vs oracle baselines on identical windows.
+
+    Returns {"policy": jct, "random": jct, <baseline>: jct, ...,
+    "policy_completion": frac, "vs_tiresias": ratio} — ratio < 1.0 means the
+    policy beats Tiresias (north-star #2, SURVEY.md §6).
+    """
+    from .experiment import load_source_trace, make_env_windows
+    from .sim.core import validate_trace
+
+    if windows is None:
+        source = load_source_trace(exp.cfg)
+        source = validate_trace(exp.env_params.sim, source, clamp=True)
+        windows = make_env_windows(exp.cfg, source)
+    traces = env_lib.stack_traces(windows, exp.env_params)
+
+    report: dict[str, Any] = {}
+    res = replay(exp.apply_fn, exp.train_state.params, exp.env_params,
+                 traces, max_steps)
+    report["policy"], report["policy_completion"] = pooled_avg_jct(res)
+    report["policy_utilization"] = float(np.mean(np.asarray(res.utilization)))
+    if include_random:
+        rnd = replay(exp.apply_fn, exp.train_state.params, exp.env_params,
+                     traces, max_steps, policy="random",
+                     key=jax.random.PRNGKey(1))
+        report["random"], _ = pooled_avg_jct(rnd)
+    report.update(baseline_jct_table(
+        windows, exp.cfg.n_nodes, exp.cfg.gpus_per_node, baselines))
+    if "tiresias" in report and report["tiresias"] > 0:
+        report["vs_tiresias"] = report["policy"] / report["tiresias"]
+    return report
+
+
+def format_report(report: dict[str, Any]) -> str:
+    """Human-readable JCT table (the BASELINE.md-style comparison)."""
+    rows = [(k, v) for k, v in report.items()
+            if isinstance(v, float) and k not in
+            ("vs_tiresias", "policy_completion", "policy_utilization")]
+    rows.sort(key=lambda kv: kv[1])
+    width = max(len(k) for k, _ in rows)
+    lines = [f"{'scheduler':<{width}}  avg JCT (s)",
+             f"{'-' * width}  -----------"]
+    for k, v in rows:
+        lines.append(f"{k:<{width}}  {v:>11.1f}")
+    if "vs_tiresias" in report:
+        lines.append(f"policy/tiresias ratio: {report['vs_tiresias']:.3f} "
+                     f"(<1 beats Tiresias)")
+    if "policy_completion" in report:
+        lines.append(f"policy completion: {report['policy_completion']:.1%}")
+    return "\n".join(lines)

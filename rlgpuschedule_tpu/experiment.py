@@ -104,7 +104,6 @@ class Experiment:
 
         key = jax.random.PRNGKey(cfg.seed)
         key, init_key, carry_key = jax.random.split(key, 3)
-        _, ts0 = env_lib.vec_reset(env_params, traces)
         algo_cfg = cfg.ppo if cfg.algo == "ppo" else cfg.a2c
         if cfg.algo == "ppo":
             tx = make_optimizer(algo_cfg)
@@ -113,10 +112,16 @@ class Experiment:
             from .algos.a2c import make_optimizer as a2c_opt
             tx = a2c_opt(algo_cfg)
             step_fn = make_a2c_step(apply_fn, env_params, algo_cfg, axis_name)
-        train_state = make_train_state(net, init_key, ts0.obs[:1],
-                                       ts0.action_mask[:1], tx, extra)
         carry = init_carry(env_params, traces, carry_key)
+        train_state = make_train_state(net, init_key, carry.obs[:1],
+                                       carry.mask[:1], tx, extra)
         if jit:
+            if axis_name is not None:
+                # pmean(axis_name) is unbound under plain jit — callers using
+                # an explicit mesh axis wrap the step in shard_map themselves
+                raise ValueError(
+                    "axis_name requires jit=False: wrap the returned "
+                    "train_step in shard_map over the mesh axis instead")
             # state and carry are replaced every iteration in run(), so
             # donating them halves live copies in the benchmarked hot loop
             step_fn = jax.jit(step_fn, donate_argnums=(0, 1))

@@ -13,7 +13,6 @@ a slice (SURVEY.md §5 "Distributed communication backend"). Axes:
 """
 from __future__ import annotations
 
-from typing import Any
 
 import jax
 import numpy as np
@@ -54,8 +53,3 @@ def pop_sharded(mesh: Mesh) -> NamedSharding:
 def pop_env_sharded(mesh: Mesh) -> NamedSharding:
     """[pop, env, ...] arrays: population × env-batch."""
     return NamedSharding(mesh, P(POP_AXIS, DATA_AXIS))
-
-
-def put(tree: Any, sharding: NamedSharding) -> Any:
-    """device_put a whole pytree under one sharding."""
-    return jax.device_put(tree, sharding)

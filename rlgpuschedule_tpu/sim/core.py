@@ -155,8 +155,19 @@ def advance_to(state: SimState, trace: Trace, t: jax.Array) -> SimState:
     t = jnp.where(finite, t, state.clock)
     dt = t - state.clock
     running = state.status == RUNNING
-    remaining = jnp.where(running, state.remaining - dt, state.remaining)
-    completed = running & (remaining <= _EPS)
+    remaining = jnp.where(running,
+                          jnp.maximum(state.remaining - dt, 0.0),
+                          state.remaining)
+    # Completion test on absolute completion time with an ulp-scaled
+    # tolerance: at large clocks the f32 spacing of ``clock + remaining``
+    # exceeds any absolute epsilon, so ``remaining - dt`` can round to a
+    # small positive value while next_event_time rounds to the current
+    # clock — a dt=0 deadlock. A few ulps of ``t`` covers the worst-case
+    # rounding of the sum without opening an early-completion window wider
+    # than f32 time resolution itself (1e-5·|t| would complete jobs seconds
+    # early on Philly-scale clocks).
+    tol = _EPS + 4.0 * jnp.spacing(t)
+    completed = running & (state.clock + state.remaining <= t + tol)
     released = jnp.sum(state.alloc * completed[:, None].astype(jnp.int32), axis=0)
     state = SimState(
         clock=t,

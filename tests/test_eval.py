@@ -1,0 +1,93 @@
+"""Evaluation-harness tests (SURVEY.md §3.4): deterministic policy replay,
+JCT table vs oracle baselines on identical windows."""
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from rlgpuschedule_tpu import eval as eval_lib
+from rlgpuschedule_tpu.algos import PPOConfig
+from rlgpuschedule_tpu.configs import CONFIGS
+from rlgpuschedule_tpu.env import stack_traces
+from rlgpuschedule_tpu.experiment import (Experiment, load_source_trace,
+                                          make_env_windows)
+from rlgpuschedule_tpu.sim.core import validate_trace
+from rlgpuschedule_tpu.sim.schedulers import evaluate_baselines
+
+
+def small_cfg(**kw):
+    return dataclasses.replace(
+        CONFIGS["ppo-mlp-synth64"], n_envs=2, window_jobs=12, horizon=96,
+        n_nodes=4, gpus_per_node=4, queue_len=4,
+        ppo=PPOConfig(n_steps=8, n_epochs=1, n_minibatches=2), **kw)
+
+
+@pytest.fixture(scope="module")
+def exp():
+    return Experiment.build(small_cfg())
+
+
+@pytest.fixture(scope="module")
+def windows(exp):
+    src = validate_trace(exp.env_params.sim, load_source_trace(exp.cfg),
+                         clamp=True)
+    return make_env_windows(exp.cfg, src)
+
+
+class TestReplay:
+    def test_greedy_replay_completes_and_is_deterministic(self, exp, windows):
+        traces = stack_traces(windows, exp.env_params)
+        r1 = eval_lib.replay(exp.apply_fn, exp.train_state.params,
+                             exp.env_params, traces)
+        r2 = eval_lib.replay(exp.apply_fn, exp.train_state.params,
+                             exp.env_params, traces)
+        np.testing.assert_array_equal(np.asarray(r1.avg_jct),
+                                      np.asarray(r2.avg_jct))
+        # horizon is generous for 12 jobs: every window must complete
+        assert (np.asarray(r1.n_done) == np.asarray(r1.n_valid)).all()
+        assert np.isfinite(np.asarray(r1.avg_jct)).all()
+        assert (np.asarray(r1.avg_jct) > 0).all()
+        assert (np.asarray(r1.utilization) > 0).all()
+        assert (np.asarray(r1.utilization) <= 1.0 + 1e-6).all()
+
+    def test_random_replay_runs(self, exp, windows):
+        traces = stack_traces(windows, exp.env_params)
+        r = eval_lib.replay(exp.apply_fn, exp.train_state.params,
+                            exp.env_params, traces, policy="random",
+                            key=jax.random.PRNGKey(7))
+        assert (np.asarray(r.n_done) == np.asarray(r.n_valid)).all()
+
+    def test_frozen_envs_stop_counting_steps(self, exp, windows):
+        traces = stack_traces(windows, exp.env_params)
+        r = eval_lib.replay(exp.apply_fn, exp.train_state.params,
+                            exp.env_params, traces, max_steps=400)
+        # steps freeze at episode end, far below max_steps
+        assert (np.asarray(r.steps) < 400).all()
+
+
+class TestJctTable:
+    def test_baseline_table_matches_single_window_oracle(self, exp, windows):
+        table = eval_lib.baseline_jct_table(
+            windows[:1], exp.cfg.n_nodes, exp.cfg.gpus_per_node,
+            names=("fifo", "sjf"))
+        direct = evaluate_baselines(windows[0], exp.cfg.n_nodes,
+                                    exp.cfg.gpus_per_node,
+                                    names=("fifo", "sjf"))
+        for k in table:
+            assert table[k] == pytest.approx(direct[k], rel=1e-6)
+
+    def test_report_has_all_schedulers_and_ratio(self, exp, windows):
+        report = eval_lib.jct_report(exp, windows=windows)
+        for k in ("policy", "random", "fifo", "sjf", "srtf", "tiresias",
+                  "vs_tiresias", "policy_completion"):
+            assert k in report, k
+        assert report["policy"] > 0
+        assert report["policy_completion"] == pytest.approx(1.0)
+        text = eval_lib.format_report(report)
+        assert "tiresias" in text and "policy" in text
+
+    def test_report_builds_own_windows_when_omitted(self, exp):
+        report = eval_lib.jct_report(exp, include_random=False,
+                                     baselines=("fifo",))
+        assert "fifo" in report and "random" not in report

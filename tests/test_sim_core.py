@@ -216,3 +216,30 @@ class TestVmap:
                 want = C.np_state(single_states[i])
                 for g, w in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
                     np.testing.assert_allclose(g, w, atol=1e-4)
+
+
+class TestFloat32Tolerance:
+    def test_completion_fires_at_large_clock(self):
+        """Regression: a job placed at a large f32 clock must still complete.
+
+        With an absolute completion epsilon, ``remaining - dt`` can round to
+        a small positive value at clocks where f32 spacing > epsilon, while
+        next_event_time rounds to the current clock — advancing dt=0 forever.
+        """
+        # chosen so f32(1288.741577… + 1720.452392…) rounds DOWN a half-ulp:
+        # the advance target then undershoots the completion time and the old
+        # absolute-epsilon test left remaining ≈ 1.2e-4 > eps forever
+        trace = to_array_trace([
+            JobRecord(0, 0.0, 1288.7415771484375, 1),
+            JobRecord(1, 0.1, 1720.4523925781250, 1),
+        ])
+        params = C.SimParams(1, 1, max_jobs=2, queue_len=2, n_placements=1)
+        tr = C.Trace.from_array_trace(trace)
+        state = C.init_state(params, tr)
+        step = jax.jit(lambda s, a: C.rl_step(params, s, tr, a))
+        # run jobs back-to-back: place head, advance, place, advance
+        for _ in range(8):
+            state, info = step(state, jnp.int32(0))
+            if bool(info.done):
+                break
+        assert bool(C.all_done(state, tr)), C.np_state(state)
