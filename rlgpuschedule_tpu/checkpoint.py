@@ -1,0 +1,133 @@
+"""Checkpoint / resume (L6 aux): Orbax persistence of training state.
+
+Capability parity: SURVEY.md §5 "Checkpoint / resume" — the reference's
+torch ``state_dict`` save/load becomes Orbax checkpointing of the
+Flax/Optax ``TrainState``. Required by PBT (exploit copies a member's
+weights, SURVEY.md §2 "PBT controller") and by failure recovery
+(checkpoint-restart is the rebuild's recovery story, SURVEY.md §5
+"Failure detection").
+
+Sharding-aware by construction: Orbax records each array leaf's
+``jax.sharding`` on save, and we restore against an abstract pytree built
+from a live template state, so mesh-placed params round-trip onto the
+same mesh layout without a host gather.
+
+Layout per step: ``state/`` (params, opt_state, step, key — arrays) +
+``meta/`` (JSON scalars: hyperparams, fitness — what PBT reads/writes).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+from flax.training.train_state import TrainState
+
+
+def _state_tree(state: TrainState, key: jax.Array | None,
+                extra: Any | None) -> dict:
+    """TrainState holds non-serializable leaves (apply_fn, tx); persist only
+    the array pytrees + step — the torch-state_dict analogue. ``extra`` is
+    any additional array pytree (the Experiment checkpoints its rollout
+    carry here so a resumed run replays the uninterrupted trajectory)."""
+    tree: dict[str, Any] = {
+        "step": state.step,
+        "params": state.params,
+        "opt_state": state.opt_state,
+    }
+    if key is not None:
+        tree["key"] = key
+    if extra is not None:
+        tree["extra"] = extra
+    return tree
+
+
+class Checkpointer:
+    """Rotating checkpoint store for one training run (or one PBT member).
+
+    >>> ckpt = Checkpointer(dir, max_to_keep=3)
+    >>> ckpt.save(step, train_state, key=rollout_key, meta={"lr": 3e-4})
+    >>> state, key, meta = ckpt.restore(train_state, key)
+    """
+
+    def __init__(self, directory: str, max_to_keep: int | None = 3):
+        self._mngr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True))
+
+    @property
+    def directory(self) -> str:
+        return str(self._mngr.directory)
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mngr.all_steps())
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def save(self, step: int, state: TrainState,
+             key: jax.Array | None = None, extra: Any | None = None,
+             meta: dict | None = None, force: bool = False) -> bool:
+        """Persist checkpoint ``step``. ``meta`` is a flat dict of JSON-able
+        scalars (PBT stores hyperparams + fitness here); ``extra`` any array
+        pytree. ``force=True`` overwrites an existing checkpoint at the same
+        step (Orbax otherwise refuses the duplicate — needed when PBT
+        exploit copies weights without a train step). Returns False when the
+        save was skipped because the step already exists."""
+        if force and step in self._mngr.all_steps():
+            # Orbax refuses duplicate steps outright (its ``force`` only
+            # bypasses save-interval policy); overwrite = delete + save
+            self._mngr.delete(step)
+        try:
+            saved = self._mngr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(_state_tree(state, key, extra)),
+                    meta=ocp.args.JsonSave(dict(meta or {}))),
+                force=force)
+        except ocp.checkpoint_manager.StepAlreadyExistsError:
+            return False
+        return bool(saved)
+
+    def restore(self, template_state: TrainState,
+                template_key: jax.Array | None = None,
+                template_extra: Any | None = None,
+                step: int | None = None,
+                ) -> tuple[TrainState, jax.Array | None, Any, dict]:
+        """Restore into the shape/dtype/sharding of ``template_state`` (a
+        live state from the same model/optimizer build — its values are
+        ignored). Pass ``template_key``/``template_extra`` iff they were
+        saved. Returns (state, key-or-None, extra-or-None, meta)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        template = _state_tree(template_state, template_key, template_extra)
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        restored = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract),
+                meta=ocp.args.JsonRestore()))
+        tree = restored["state"]
+        state = template_state.replace(
+            step=tree["step"], params=tree["params"],
+            opt_state=tree["opt_state"])
+        return state, tree.get("key"), tree.get("extra"), dict(
+            restored["meta"] or {})
+
+    def wait(self) -> None:
+        """Block until async saves are durable (call before reading the
+        files from another process, e.g. a PBT exploit copy)."""
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+    def __enter__(self) -> "Checkpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -12,7 +12,6 @@ baseline side replays the same windows through the oracle event-driven sim
 """
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -22,8 +21,7 @@ import numpy as np
 from .env import env as env_lib
 from .env.env import EnvParams
 from .sim import core
-from .sim.oracle import OracleSim
-from .sim.schedulers import BASELINES, run_scheduler
+from .sim.schedulers import run_baseline
 from .traces.records import ArrayTrace
 
 
@@ -61,6 +59,9 @@ def replay(apply_fn: Callable, net_params: Any, env_params: EnvParams,
     SURVEY.md §3.4) or "random" (masked-uniform; the learning-smoke-test
     baseline, SURVEY.md §4 "policy beats random").
     """
+    if policy not in ("greedy", "random"):
+        raise ValueError(f"unknown replay policy {policy!r}; "
+                         f"expected 'greedy' or 'random'")
     max_steps = int(max_steps or env_params.horizon)
     if key is None:
         key = jax.random.PRNGKey(0)
@@ -125,8 +126,7 @@ def baseline_jct_table(windows: list[ArrayTrace], n_nodes: int,
     for name in names:
         tot_jct, tot_n = 0.0, 0
         for w in windows:
-            sim = OracleSim(w, n_nodes, gpus_per_node)
-            run_scheduler(sim, BASELINES[name]())
+            sim = run_baseline(w, n_nodes, gpus_per_node, name)
             n = sum(1 for j in range(w.max_jobs)
                     if w.valid[j] and np.isfinite(sim.finish[j]))
             tot_jct += sim.avg_jct() * n
@@ -147,14 +147,12 @@ def jct_report(exp, windows: list[ArrayTrace] | None = None,
     "policy_completion": frac, "vs_tiresias": ratio} — ratio < 1.0 means the
     policy beats Tiresias (north-star #2, SURVEY.md §6).
     """
-    from .experiment import load_source_trace, make_env_windows
-    from .sim.core import validate_trace
-
     if windows is None:
-        source = load_source_trace(exp.cfg)
-        source = validate_trace(exp.env_params.sim, source, clamp=True)
-        windows = make_env_windows(exp.cfg, source)
-    traces = env_lib.stack_traces(windows, exp.env_params)
+        # the windows the experiment trained on (already validated/clamped
+        # at build) — no re-ingest of the source trace
+        windows, traces = exp.windows, exp.traces
+    else:
+        traces = env_lib.stack_traces(windows, exp.env_params)
 
     report: dict[str, Any] = {}
     res = replay(exp.apply_fn, exp.train_state.params, exp.env_params,

@@ -72,6 +72,7 @@ class Experiment:
     """Assembled experiment: jitted train step + host loop."""
     cfg: ExperimentConfig
     env_params: EnvParams
+    windows: list            # host ArrayTrace windows (reused by eval)
     traces: Any              # batched device Trace [E, ...]
     net: Any
     apply_fn: Callable
@@ -87,7 +88,8 @@ class Experiment:
         source = load_source_trace(cfg)
         from .sim.core import validate_trace
         source = validate_trace(env_params.sim, source, clamp=True)
-        traces = stack_traces(make_env_windows(cfg, source), env_params)
+        windows = make_env_windows(cfg, source)
+        traces = stack_traces(windows, env_params)
 
         net = make_policy(cfg.obs_kind, env_params.n_actions,
                           n_cluster_nodes=cfg.n_nodes,
@@ -125,14 +127,38 @@ class Experiment:
             # state and carry are replaced every iteration in run(), so
             # donating them halves live copies in the benchmarked hot loop
             step_fn = jax.jit(step_fn, donate_argnums=(0, 1))
-        return Experiment(cfg=cfg, env_params=env_params, traces=traces,
-                          net=net, apply_fn=apply_fn, train_state=train_state,
-                          train_step=step_fn, carry=carry, key=key)
+        return Experiment(cfg=cfg, env_params=env_params, windows=windows,
+                          traces=traces, net=net, apply_fn=apply_fn,
+                          train_state=train_state, train_step=step_fn,
+                          carry=carry, key=key)
 
     @property
     def steps_per_iteration(self) -> int:
         algo_cfg = self.cfg.ppo if self.cfg.algo == "ppo" else self.cfg.a2c
         return algo_cfg.n_steps * self.cfg.n_envs
+
+    def save_checkpoint(self, ckpt, step: int | None = None,
+                        meta: dict | None = None, force: bool = False) -> bool:
+        """Persist train state + rollout PRNG key + rollout carry
+        (``checkpoint.Checkpointer``). Pass ``force=True`` to overwrite an
+        existing checkpoint at the same step (e.g. a PBT exploit that copies
+        weights without advancing the optimizer)."""
+        step = int(self.train_state.step) if step is None else step
+        return ckpt.save(step, self.train_state, key=self.key,
+                         extra=self.carry, meta=meta, force=force)
+
+    def restore_checkpoint(self, ckpt, step: int | None = None) -> dict:
+        """Restore train state + key + rollout carry in place; returns the
+        checkpoint meta. With the carry restored, a resumed ``run()``
+        reproduces the uninterrupted run exactly. The experiment must be
+        built from the same config (shapes must match)."""
+        self.train_state, key, carry, meta = ckpt.restore(
+            self.train_state, self.key, self.carry, step)
+        if key is not None:
+            self.key = key
+        if carry is not None:
+            self.carry = carry
+        return meta
 
     def run(self, iterations: int | None = None, log_every: int = 0,
             logger: Callable[[int, dict], None] | None = None) -> dict:

@@ -131,13 +131,17 @@ def run_scheduler(sim: OracleSim, policy: SchedulerPolicy,
     raise RuntimeError("max_events exceeded")
 
 
+def run_baseline(trace, n_nodes: int, gpus_per_node: int,
+                 name: str) -> OracleSim:
+    """Run one named baseline over a trace; returns the finished sim (the
+    single implementation behind every baseline JCT table)."""
+    sim = OracleSim(trace, n_nodes, gpus_per_node)
+    return run_scheduler(sim, BASELINES[name]())
+
+
 def evaluate_baselines(trace, n_nodes: int, gpus_per_node: int,
                        names: Sequence[str] = ("fifo", "sjf", "srtf", "tiresias"),
                        ) -> dict[str, float]:
     """Avg-JCT table for the requested baselines on one trace."""
-    out = {}
-    for name in names:
-        sim = OracleSim(trace, n_nodes, gpus_per_node)
-        run_scheduler(sim, BASELINES[name]())
-        out[name] = sim.avg_jct()
-    return out
+    return {name: run_baseline(trace, n_nodes, gpus_per_node, name).avg_jct()
+            for name in names}

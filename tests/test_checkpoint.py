@@ -1,0 +1,126 @@
+"""Checkpoint/resume tests (SURVEY.md §5 "Checkpoint / resume"): Orbax
+roundtrip of TrainState, rotation, meta payloads, sharded restore, and
+experiment-level resume determinism."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax.training.train_state import TrainState
+
+from rlgpuschedule_tpu.checkpoint import Checkpointer
+from rlgpuschedule_tpu.algos import PPOConfig
+from rlgpuschedule_tpu.configs import CONFIGS
+from rlgpuschedule_tpu.experiment import Experiment
+
+
+def _mk_state(value: float, step: int = 0) -> TrainState:
+    params = {"w": jnp.full((4, 3), value), "b": jnp.zeros((3,))}
+    state = TrainState.create(apply_fn=lambda p, x: x, params=params,
+                              tx=optax.adam(1e-3))
+    return state.replace(step=step)
+
+
+class TestCheckpointer:
+    def test_roundtrip_params_opt_state_step_key_meta(self, tmp_path):
+        key = jax.random.PRNGKey(7)
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            state = _mk_state(2.5, step=11)
+            # advance optimizer state so opt_state restore is non-trivial
+            grads = jax.tree.map(jnp.ones_like, state.params)
+            state = state.apply_gradients(grads=grads)
+            ck.save(12, state, key=key, meta={"lr": 1e-3, "gen": 3})
+            ck.wait()
+
+            restored, rkey, extra, meta = ck.restore(_mk_state(0.0), key * 0)
+        assert int(restored.step) == int(state.step)
+        assert np.allclose(restored.params["w"], state.params["w"])
+        chex_leaves = jax.tree.leaves(restored.opt_state)
+        orig_leaves = jax.tree.leaves(state.opt_state)
+        for a, b in zip(chex_leaves, orig_leaves):
+            assert np.allclose(a, b)
+        assert np.array_equal(rkey, key)
+        assert meta == {"lr": 1e-3, "gen": 3}
+
+    def test_restore_without_key(self, tmp_path):
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            ck.save(0, _mk_state(1.0))
+            ck.wait()
+            restored, rkey, extra, meta = ck.restore(_mk_state(0.0))
+        assert rkey is None and extra is None and meta == {}
+        assert np.allclose(restored.params["w"], 1.0)
+
+    def test_rotation_keeps_max_to_keep(self, tmp_path):
+        with Checkpointer(str(tmp_path / "ck"), max_to_keep=2) as ck:
+            for s in range(4):
+                ck.save(s, _mk_state(float(s), step=s))
+            ck.wait()
+            assert ck.all_steps() == [2, 3]
+            assert ck.latest_step() == 3
+            # restore a specific retained step
+            restored, _, _, _ = ck.restore(_mk_state(0.0), step=2)
+        assert np.allclose(restored.params["w"], 2.0)
+
+    def test_restore_empty_raises(self, tmp_path):
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            with pytest.raises(FileNotFoundError):
+                ck.restore(_mk_state(0.0))
+
+    def test_sharded_state_roundtrips_onto_mesh(self, tmp_path):
+        """Replicated-on-mesh params save and restore with shardings intact
+        (SURVEY.md §5: 'sharded-aware')."""
+        from rlgpuschedule_tpu.parallel import make_mesh
+        from rlgpuschedule_tpu.parallel.mesh import replicated
+
+        mesh = make_mesh(4)
+        state = jax.device_put(_mk_state(3.0, step=5), replicated(mesh))
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            ck.save(5, state)
+            ck.wait()
+            template = jax.device_put(_mk_state(0.0), replicated(mesh))
+            restored, _, _, _ = ck.restore(template)
+        assert restored.params["w"].sharding == state.params["w"].sharding
+        assert np.allclose(restored.params["w"], 3.0)
+
+
+class TestExperimentResume:
+    def test_resume_continues_identically(self, tmp_path):
+        """Train 2 iters, checkpoint, train 2 more; a fresh build restored
+        from the checkpoint reproduces the same final params (fixed-seed
+        determinism, SURVEY.md §4 'Determinism/regression')."""
+        cfg = dataclasses.replace(
+            CONFIGS["ppo-mlp-synth64"], n_envs=2, window_jobs=16, horizon=64,
+            ppo=PPOConfig(n_steps=8, n_epochs=1, n_minibatches=2))
+        exp = Experiment.build(cfg)
+        exp.run(iterations=2)
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            exp.save_checkpoint(ck, meta={"iters": 2})
+            ck.wait()
+            exp.run(iterations=2)
+            final = jax.tree.map(np.asarray, exp.train_state.params)
+
+            exp2 = Experiment.build(cfg)
+            meta = exp2.restore_checkpoint(ck)
+        assert meta == {"iters": 2}
+        exp2.run(iterations=2)
+        final2 = jax.tree.map(np.asarray, exp2.train_state.params)
+        for a, b in zip(jax.tree.leaves(final), jax.tree.leaves(final2)):
+            assert np.allclose(a, b, atol=1e-6)
+
+    def test_force_overwrites_same_step(self, tmp_path):
+        """Weight copies without an optimizer update (PBT exploit) land at
+        the same step; force=True must overwrite, plain save must report the
+        silent skip."""
+        state = _mk_state(1.0, step=3)
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            assert ck.save(3, state, meta={"v": 1})
+            ck.wait()
+            assert not ck.save(3, _mk_state(9.0, step=3), meta={"v": 2})
+            assert ck.save(3, _mk_state(9.0, step=3), meta={"v": 2},
+                           force=True)
+            ck.wait()
+            restored, _, _, meta = ck.restore(_mk_state(0.0))
+        assert np.allclose(restored.params["w"], 9.0)
+        assert meta == {"v": 2}
