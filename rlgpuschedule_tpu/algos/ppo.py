@@ -61,24 +61,31 @@ class PPOMetrics(NamedTuple):
 
 
 def ppo_loss(apply_fn: PolicyApply, net_params, batch: Transition,
-             advantages: jax.Array, returns: jax.Array, config: PPOConfig):
+             advantages: jax.Array, returns: jax.Array, config: PPOConfig,
+             clip_eps: jax.Array | float | None = None,
+             ent_coef: jax.Array | float | None = None):
+    """``clip_eps`` / ``ent_coef`` default to the (static) config values;
+    pass traced scalars to make them per-member PBT-explorable
+    (``parallel.population``) without recompilation."""
+    clip_eps = config.clip_eps if clip_eps is None else clip_eps
+    ent_coef = config.ent_coef if ent_coef is None else ent_coef
     logits, value = apply_fn(net_params, batch.obs, batch.mask)
     logp_all = jax.nn.log_softmax(logits)
     log_prob = jnp.take_along_axis(logp_all, batch.action[:, None],
                                    axis=1).squeeze(1)
     ratio = jnp.exp(log_prob - batch.log_prob)
     pg1 = ratio * advantages
-    pg2 = jnp.clip(ratio, 1 - config.clip_eps, 1 + config.clip_eps) * advantages
+    pg2 = jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * advantages
     pg_loss = -jnp.mean(jnp.minimum(pg1, pg2))
     # clipped value loss (PPO2-style trust region on the critic)
     v_clipped = batch.value + jnp.clip(value - batch.value,
-                                       -config.clip_eps, config.clip_eps)
+                                       -clip_eps, clip_eps)
     v_loss = 0.5 * jnp.mean(jnp.maximum((value - returns) ** 2,
                                         (v_clipped - returns) ** 2))
     entropy = jnp.mean(masked_entropy(logits))
-    total = pg_loss + config.vf_coef * v_loss - config.ent_coef * entropy
+    total = pg_loss + config.vf_coef * v_loss - ent_coef * entropy
     approx_kl = jnp.mean(batch.log_prob - log_prob)
-    clip_frac = jnp.mean((jnp.abs(ratio - 1.0) > config.clip_eps)
+    clip_frac = jnp.mean((jnp.abs(ratio - 1.0) > clip_eps)
                          .astype(jnp.float32))
     return total, (pg_loss, v_loss, entropy, approx_kl, clip_frac)
 

@@ -74,11 +74,22 @@ class Checkpointer:
         pytree. ``force=True`` overwrites an existing checkpoint at the same
         step (Orbax otherwise refuses the duplicate — needed when PBT
         exploit copies weights without a train step). Returns False when the
-        save was skipped because the step already exists."""
-        if force and step in self._mngr.all_steps():
-            # Orbax refuses duplicate steps outright (its ``force`` only
-            # bypasses save-interval policy); overwrite = delete + save
-            self._mngr.delete(step)
+        save was skipped because the step already exists.
+
+        A forced overwrite is delete-then-save (Orbax cannot swap a step in
+        place), so it runs synchronously to keep the no-copy window as small
+        as one save; a crash inside that window falls back to the previous
+        retained step. Keep ``max_to_keep >= 2`` if you force-overwrite your
+        only step."""
+        if force:
+            # an in-flight async save of the same step is invisible to
+            # all_steps() until finalized — settle it first so force can't
+            # silently degrade to a skipped save
+            self._mngr.wait_until_finished()
+            if step in self._mngr.all_steps():
+                # Orbax refuses duplicate steps outright (its ``force`` only
+                # bypasses save-interval policy); overwrite = delete + save
+                self._mngr.delete(step)
         try:
             saved = self._mngr.save(
                 step,
@@ -88,6 +99,8 @@ class Checkpointer:
                 force=force)
         except ocp.checkpoint_manager.StepAlreadyExistsError:
             return False
+        if force:
+            self._mngr.wait_until_finished()
         return bool(saved)
 
     def restore(self, template_state: TrainState,

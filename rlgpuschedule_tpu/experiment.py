@@ -19,7 +19,7 @@ from .configs import ExperimentConfig
 from .env import EnvParams, build_adjacency, stack_traces
 from .env import env as env_lib
 from .models import make_policy
-from .sim.core import SimParams
+from .sim.core import SimParams, validate_trace
 from .traces import (ArrayTrace, gen_poisson_trace, load_pai, load_philly)
 from flax.training.train_state import TrainState
 
@@ -49,6 +49,31 @@ def load_source_trace(cfg: ExperimentConfig, n_jobs: int | None = None,
             f"trace_path; pass one (CSV) or use trace='synthetic'")
     loader = load_philly if cfg.trace == "philly" else load_pai
     return loader(cfg.trace_path, max_jobs=n_jobs)
+
+
+def build_stack(cfg: ExperimentConfig):
+    """Shared assembly for single-run and population experiments: trace
+    load/validate/window/stack + policy net + (obs, mask) apply closure.
+    Returns (env_params, windows, traces [E, ...], net, apply_fn, extra)
+    where ``extra`` are the apply args between obs and mask (the GNN's
+    adjacency)."""
+    env_params = build_env_params(cfg)
+    source = validate_trace(env_params.sim, load_source_trace(cfg),
+                            clamp=True)
+    windows = make_env_windows(cfg, source)
+    traces = stack_traces(windows, env_params)
+    net = make_policy(cfg.obs_kind, env_params.n_actions,
+                      n_cluster_nodes=cfg.n_nodes, queue_len=cfg.queue_len,
+                      n_placements=cfg.n_placements)
+    if cfg.obs_kind == "graph":
+        adj = jnp.asarray(build_adjacency(cfg.n_nodes, cfg.queue_len,
+                                          cfg.nodes_per_rack))
+        apply_fn = lambda p, obs, mask: net.apply(p, obs, adj, mask)
+        extra = (adj,)
+    else:
+        apply_fn = lambda p, obs, mask: net.apply(p, obs, mask)
+        extra = ()
+    return env_params, windows, traces, net, apply_fn, extra
 
 
 def make_env_windows(cfg: ExperimentConfig, source: ArrayTrace,
@@ -84,26 +109,7 @@ class Experiment:
     @staticmethod
     def build(cfg: ExperimentConfig, axis_name: str | None = None,
               jit: bool = True) -> "Experiment":
-        env_params = build_env_params(cfg)
-        source = load_source_trace(cfg)
-        from .sim.core import validate_trace
-        source = validate_trace(env_params.sim, source, clamp=True)
-        windows = make_env_windows(cfg, source)
-        traces = stack_traces(windows, env_params)
-
-        net = make_policy(cfg.obs_kind, env_params.n_actions,
-                          n_cluster_nodes=cfg.n_nodes,
-                          queue_len=cfg.queue_len,
-                          n_placements=cfg.n_placements)
-        if cfg.obs_kind == "graph":
-            adj = jnp.asarray(build_adjacency(cfg.n_nodes, cfg.queue_len,
-                                              cfg.nodes_per_rack))
-            apply_fn = lambda p, obs, mask: net.apply(p, obs, adj, mask)
-            extra = (adj,)
-        else:
-            apply_fn = lambda p, obs, mask: net.apply(p, obs, mask)
-            extra = ()
-
+        env_params, windows, traces, net, apply_fn, extra = build_stack(cfg)
         key = jax.random.PRNGKey(cfg.seed)
         key, init_key, carry_key = jax.random.split(key, 3)
         algo_cfg = cfg.ppo if cfg.algo == "ppo" else cfg.a2c
@@ -181,4 +187,117 @@ class Experiment:
         return {"wall_s": wall, "iterations": iterations,
                 "env_steps": total_env_steps,
                 "env_steps_per_sec": total_env_steps / wall,
+                "history": history}
+
+
+@dataclasses.dataclass
+class PopulationExperiment:
+    """Config 5 assembly: a population of PPO members trained as one
+    vmapped+pop-sharded program, with host-side PBT exploit/explore
+    (SURVEY.md §3.5). Each member runs the per-member config ``cfg`` (for
+    the driver's config 5 that is the hierarchical 4-pod agent,
+    ``configs.HIER_PBT_MEMBER``)."""
+    cfg: ExperimentConfig
+    n_pop: int
+    env_params: EnvParams
+    traces: Any              # [P, E, ...] batched device Trace
+    apply_fn: Callable
+    states: Any              # stacked MemberState [P, ...]
+    carries: Any             # stacked RolloutCarry [P, ...]
+    hparams: Any             # HParams stacked [P]
+    keys: jax.Array          # [P, 2] per-member rollout keys
+    pop_step: Callable       # jitted
+    controller: Any          # PBTController
+
+    @staticmethod
+    def build(cfg: ExperimentConfig, n_pop: int = 4, mesh=None,
+              pbt_cfg=None) -> "PopulationExperiment":
+        from .parallel.pbt import PBTConfig, PBTController
+        from .parallel.population import (init_member, jit_population_step,
+                                          make_population_step,
+                                          sample_hparams, stack_members)
+        if cfg.algo != "ppo":
+            raise ValueError(
+                f"PopulationExperiment trains PPO members (PBT explores "
+                f"PPO hyperparameters); config {cfg.name!r} has "
+                f"algo={cfg.algo!r}")
+        pbt_cfg = pbt_cfg or PBTConfig(seed=cfg.seed)
+        env_params, _windows, traces, net, apply_fn, extra = build_stack(cfg)
+        # traces stay unstacked [E, ...]: every member trains on the same
+        # env windows (PBT fitness comparability) and the vmapped step
+        # broadcasts them (in_axes=None) instead of holding n_pop copies
+
+        key = jax.random.PRNGKey(cfg.seed)
+        member_keys = jax.random.split(key, n_pop * 3).reshape(n_pop, 3, 2)
+        members, carries = [], []
+        for p in range(n_pop):
+            carry = init_carry(env_params, traces, member_keys[p, 1])
+            members.append(init_member(net, member_keys[p, 0],
+                                       carry.obs[:1], carry.mask[:1],
+                                       cfg.ppo, extra))
+            carries.append(carry)
+        states = stack_members(members)
+        stacked_carries = stack_members(carries)
+        hparams = sample_hparams(cfg.ppo, n_pop, cfg.seed)
+        keys = member_keys[:, 2]
+
+        pop_step = make_population_step(apply_fn, env_params, cfg.ppo)
+        if mesh is not None:
+            if n_pop % mesh.shape["pop"] != 0:
+                raise ValueError(f"n_pop={n_pop} not divisible by pop axis "
+                                 f"size {mesh.shape['pop']}")
+            jitted = jit_population_step(mesh, pop_step)
+            from .parallel.population import population_shardings
+            st_sh, ca_sh, tr_sh, key_sh, hp_sh = population_shardings(mesh)
+            states = jax.device_put(states, st_sh)
+            stacked_carries = jax.device_put(stacked_carries, ca_sh)
+            traces = jax.device_put(traces, tr_sh)
+            keys = jax.device_put(keys, key_sh)
+            hparams = jax.device_put(hparams, hp_sh)
+        else:
+            jitted = jax.jit(pop_step, donate_argnums=(0, 1))
+        return PopulationExperiment(
+            cfg=cfg, n_pop=n_pop, env_params=env_params, traces=traces,
+            apply_fn=apply_fn, states=states, carries=stacked_carries,
+            hparams=hparams, keys=keys, pop_step=jitted,
+            controller=PBTController(n_pop, pbt_cfg))
+
+    @property
+    def steps_per_iteration(self) -> int:
+        return self.cfg.ppo.n_steps * self.cfg.n_envs * self.n_pop
+
+    def run(self, iterations: int | None = None, log_every: int = 0,
+            logger: Callable[[int, dict], None] | None = None) -> dict:
+        """Train the population; PBT exploit/explore fires every
+        ``controller.cfg.ready_iters`` iterations. Returns summary metrics
+        including per-member final fitness and the PBT event log."""
+        iterations = iterations or self.cfg.iterations
+        split_all = jax.jit(jax.vmap(lambda k: jax.random.split(k)))
+        history = []
+        t0 = time.time()
+        for i in range(iterations):
+            both = split_all(self.keys)
+            self.keys, subs = both[:, 0], both[:, 1]
+            self.states, self.carries, metrics = self.pop_step(
+                self.states, self.carries, self.traces, subs, self.hparams)
+            fitness = metrics.mean_reward
+            self.controller.record(fitness)
+            out = self.controller.maybe_update(i, self.states, self.hparams)
+            if out is not None:
+                self.states, self.hparams, _decision = out
+            if log_every and (i % log_every == 0 or i == iterations - 1):
+                m = {k: [float(x) for x in v]
+                     for k, v in metrics._asdict().items()}
+                history.append({"iteration": i, **m})
+                if logger is not None:
+                    logger(i, m)
+        jax.block_until_ready(self.states.params)
+        wall = time.time() - t0
+        total_env_steps = iterations * self.steps_per_iteration
+        return {"wall_s": wall, "iterations": iterations,
+                "env_steps": total_env_steps,
+                "env_steps_per_sec": total_env_steps / wall,
+                "final_fitness": [float(f) for f in
+                                  self.controller.mean_fitness],
+                "pbt_events": len(self.controller.history),
                 "history": history}

@@ -1,0 +1,162 @@
+"""Population-based training controller (L5) — config 5's exploit/explore.
+
+Capability parity: SURVEY.md §2 "PBT controller" and §3.5: periodically
+rank members by fitness; the bottom quantile copies weights + optimizer
+state + hyperparameters from a random top-quantile member (**exploit**)
+and perturbs the copied hyperparameters (**explore**).
+
+TPU-native mechanics: the decision logic (rank, pair losers with winners,
+perturb) is tiny host numpy; the weight transfer is ONE jitted gather
+``tree.map(lambda x: x[src], stacked_members)`` over the pop-sharded
+member stack — XLA lowers it to the cross-``pop`` collective (DCN between
+pod slices in a multi-slice deployment), replacing the reference's NCCL
+broadcast of state_dicts (SURVEY.md §2 "Distributed comm backend",
+"NCCL broadcast/gather (PBT weight exchange)").
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .population import HPARAM_BOUNDS, HParams
+
+
+@dataclasses.dataclass(frozen=True)
+class PBTConfig:
+    ready_iters: int = 10        # iterations between exploit/explore rounds
+    exploit_frac: float = 0.25   # bottom quantile replaced from top quantile
+    perturb_low: float = 0.8     # explore: multiply each hparam by
+    perturb_high: float = 1.25   #   low or high, chosen uniformly
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class PBTDecision:
+    """One exploit/explore round's outcome (host-side, for logging)."""
+    src: np.ndarray        # i32[P] — member i copies from src[i] (i = keep)
+    exploited: np.ndarray  # bool[P]
+    hparams: HParams       # post-explore stacked [P] hparams
+
+
+def exploit_explore(rng: np.random.Generator, fitness: np.ndarray,
+                    hparams: HParams, cfg: PBTConfig) -> PBTDecision:
+    """Truncation-selection PBT: bottom ``exploit_frac`` of members copy a
+    uniformly-chosen top-``exploit_frac`` member and perturb its hparams.
+    NaN fitness (a diverged member) ranks as worst, so divergence is culled
+    by exploit instead of copied (argsort would otherwise sort NaN last =
+    top)."""
+    fitness = np.where(np.isnan(fitness), -np.inf, fitness)
+    n = len(fitness)
+    k = max(int(np.floor(n * cfg.exploit_frac)), 1) if n > 1 else 0
+    order = np.argsort(fitness)           # ascending: losers first
+    losers, winners = order[:k], order[n - k:] if k else order[:0]
+    src = np.arange(n)
+    if k:
+        src[losers] = rng.choice(winners, size=k)
+    exploited = src != np.arange(n)
+
+    hp = jax.tree.map(np.asarray, hparams)
+    new_hp = {}
+    for name in HParams._fields:
+        vals = np.array(hp._asdict()[name][src], dtype=np.float32)
+        factors = rng.choice([cfg.perturb_low, cfg.perturb_high], size=n)
+        lo, hi = HPARAM_BOUNDS[name]
+        vals = np.where(exploited,
+                        np.clip(vals * factors, lo, hi), vals)
+        new_hp[name] = jnp.asarray(vals.astype(np.float32))
+    return PBTDecision(src=src, exploited=exploited,
+                       hparams=HParams(**new_hp))
+
+
+# compiled gather per (treedef, leaf avals+shardings) — a PBT run hits one
+# entry, so exploit rounds reuse the compilation instead of re-tracing a
+# fresh lambda every round
+_GATHER_CACHE: dict = {}
+
+
+def _gather_fn(t, src):
+    return jax.tree.map(lambda x: x[src], t)
+
+
+def gather_members(stacked: Any, src: np.ndarray | jax.Array) -> Any:
+    """Copy member src[i] -> slot i across a stacked [P, ...] pytree (the
+    exploit weight transfer). jit-compiled with the inputs' shardings pinned
+    on the outputs — a bare jit would let the compiler replicate the
+    gathered copies off the ``pop`` axis."""
+    src = jnp.asarray(src)
+    leaves, treedef = jax.tree.flatten(stacked)
+    key = (treedef,
+           tuple((l.shape, str(l.dtype), l.sharding) for l in leaves))
+    fn = _GATHER_CACHE.get(key)
+    if fn is None:
+        out_sh = jax.tree.map(lambda x: x.sharding, stacked)
+        fn = _GATHER_CACHE[key] = jax.jit(_gather_fn, out_shardings=out_sh)
+    return fn(stacked, src)
+
+
+class PBTController:
+    """Host-side fitness accounting + periodic exploit/explore.
+
+    Usage per training iteration ``i``::
+
+        ctrl.record(metrics.mean_reward)        # [P] per-member fitness
+        out = ctrl.maybe_update(i, states, hparams)
+        if out is not None:
+            states, hparams, decision = out
+    """
+
+    def __init__(self, n_pop: int, cfg: PBTConfig = PBTConfig()):
+        self.cfg = cfg
+        self.n_pop = n_pop
+        self._rng = np.random.default_rng(cfg.seed)
+        # fitness arrives as device arrays and is NOT synced on record —
+        # the host loop stays ahead of the device (async dispatch); we only
+        # materialize at the ready boundary
+        self._pending: list = []
+        self._fitness_sum = np.zeros(n_pop)
+        self._fitness_n = 0
+        self.history: list[PBTDecision] = []
+
+    def record(self, fitness: jax.Array | np.ndarray) -> None:
+        """Queue one iteration's per-member fitness [P]; no device sync."""
+        self._pending.append(fitness)
+
+    def _drain(self) -> None:
+        for f in self._pending:
+            self._fitness_sum += np.asarray(f, dtype=np.float64)
+            self._fitness_n += 1
+        self._pending.clear()
+
+    @property
+    def mean_fitness(self) -> np.ndarray:
+        """Per-member mean fitness over the current window — or, right
+        after an exploit/explore round reset the window, over the window
+        that round was decided on (so end-of-run reporting never reads an
+        empty accumulator as zeros)."""
+        self._drain()
+        if self._fitness_n == 0 and self.history:
+            return self._last_window_fitness
+        return self._fitness_sum / max(self._fitness_n, 1)
+
+    def maybe_update(self, iteration: int, states: Any, hparams: HParams,
+                     ) -> tuple[Any, HParams, PBTDecision] | None:
+        """After every ``ready_iters`` recorded iterations, run one
+        exploit/explore round over the stacked member states. Returns None
+        when not due (and then costs no device sync)."""
+        if (len(self._pending) + self._fitness_n < self.cfg.ready_iters
+                or iteration == 0):
+            return None
+        self._drain()
+        fitness = self._fitness_sum / max(self._fitness_n, 1)
+        decision = exploit_explore(self._rng, fitness, hparams, self.cfg)
+        self._last_window_fitness = fitness
+        self._fitness_sum[:] = 0.0
+        self._fitness_n = 0
+        self.history.append(decision)
+        if decision.exploited.any():
+            states = gather_members(states, decision.src)
+        return states, decision.hparams, decision

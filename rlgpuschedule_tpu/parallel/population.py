@@ -1,0 +1,202 @@
+"""Population training over the ``pop`` mesh axis (L5) — the substrate for
+PBT / hierarchical config 5.
+
+Capability parity: SURVEY.md §2 "PBT controller" / §2 "Parallelism
+strategies — Population parallelism": the reference trains population
+members as separate processes exchanging weights over NCCL; here the whole
+population is ONE jitted program — the member train step is ``vmap``-ped
+over a stacked member axis and the stack is sharded over the mesh's ``pop``
+axis, so each pod slice trains its members locally and the only cross-pod
+traffic is the rare PBT exploit weight copy (a gather over ``pop``, riding
+DCN in a real multi-slice deployment — SURVEY.md §5 "Distributed
+communication backend").
+
+Per-member hyperparameters (lr, entropy coef, clip eps) are **traced
+scalars** (:class:`HParams`), not Python config constants — PBT's explore
+step rewrites them between iterations without recompiling, and one compiled
+step serves every member. The learning rate is applied manually after
+``scale_by_adam`` for the same reason (optimizer state holds no lr).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..algos.ppo import PPOConfig, PPOMetrics, ppo_loss
+from ..algos.rollout import PolicyApply, RolloutCarry, rollout
+from ..env.env import EnvParams
+from ..ops.gae import compute_gae
+from .mesh import Mesh, env_sharded, pop_env_sharded, pop_sharded
+
+
+class HParams(NamedTuple):
+    """PBT-explorable hyperparameters — traced f32 scalars (stacked [P]
+    across the population)."""
+    lr: jax.Array
+    ent_coef: jax.Array
+    clip_eps: jax.Array
+
+
+# Legal range per hyperparameter; initial sampling and PBT explore both
+# clip to these.
+HPARAM_BOUNDS: dict[str, tuple[float, float]] = {
+    "lr": (1e-5, 1e-2),
+    "ent_coef": (1e-4, 0.3),
+    "clip_eps": (0.05, 0.5),
+}
+
+
+class MemberState(NamedTuple):
+    """One member's learnable state (stacked [P, ...] across the
+    population). Plain pytree (not flax TrainState) because the lr lives in
+    :class:`HParams`, not in the optimizer."""
+    params: Any
+    opt_state: optax.OptState
+    step: jax.Array
+
+
+def make_member_tx(config: PPOConfig) -> optax.GradientTransformation:
+    """Adam preconditioner without a learning rate — the per-member lr is
+    applied by the member step from traced ``HParams``."""
+    return optax.chain(optax.clip_by_global_norm(config.max_grad_norm),
+                       optax.scale_by_adam(eps=1e-5))
+
+
+def init_member(net, key: jax.Array, example_obs, example_mask,
+                config: PPOConfig, extra_apply_args: tuple = ()) -> MemberState:
+    params = net.init(key, example_obs, *extra_apply_args, example_mask)
+    tx = make_member_tx(config)
+    return MemberState(params=params, opt_state=tx.init(params),
+                       step=jnp.int32(0))
+
+
+def make_member_step(apply_fn: PolicyApply, env_params: EnvParams,
+                     config: PPOConfig) -> Callable:
+    """One member's PPO iteration with traced hyperparameters:
+    (member_state, carry, traces, key, hp) -> (member_state', carry',
+    metrics). Mirrors ``algos.ppo.make_train_step`` (see its docstring for
+    the scan structure) with hp.{clip_eps, ent_coef} fed into the loss and
+    hp.lr applied to the adam-preconditioned updates."""
+    tx = make_member_tx(config)
+
+    def member_step(state: MemberState, carry: RolloutCarry, traces,
+                    key: jax.Array, hp: HParams):
+        carry, tr, last_value = rollout(apply_fn, state.params, env_params,
+                                        traces, carry, config.n_steps)
+        advantages, returns = compute_gae(tr.reward, tr.value, tr.done,
+                                          last_value, config.gamma,
+                                          config.gae_lambda)
+        # same moment-form normalization as algos.ppo.make_train_step so a
+        # member with hp == config reproduces the single-run step bit-close
+        adv_mean = jnp.mean(advantages)
+        adv_var = jnp.mean(advantages ** 2) - adv_mean ** 2
+        advantages = (advantages - adv_mean) / jnp.sqrt(adv_var + 1e-8)
+
+        B = config.n_steps * tr.reward.shape[1]
+        flat = jax.tree.map(lambda x: x.reshape(B, *x.shape[2:]), tr)
+        adv_flat = advantages.reshape(B)
+        ret_flat = returns.reshape(B)
+        mb_size = B // config.n_minibatches
+        assert mb_size * config.n_minibatches == B, \
+            "n_steps * n_envs must be divisible by n_minibatches"
+
+        def epoch(state_and_key, _):
+            state, key = state_and_key
+            key, sub = jax.random.split(key)
+            perm = jax.random.permutation(sub, B)
+            mb_idx = perm.reshape(config.n_minibatches, mb_size)
+
+            def minibatch(state: MemberState, idx):
+                mb = jax.tree.map(lambda x: x[idx], flat)
+                (loss, aux), grads = jax.value_and_grad(
+                    ppo_loss, argnums=1, has_aux=True)(
+                    apply_fn, state.params, mb, adv_flat[idx], ret_flat[idx],
+                    config, clip_eps=hp.clip_eps, ent_coef=hp.ent_coef)
+                updates, opt_state = tx.update(grads, state.opt_state,
+                                               state.params)
+                updates = jax.tree.map(lambda u: -hp.lr * u, updates)
+                state = MemberState(
+                    params=optax.apply_updates(state.params, updates),
+                    opt_state=opt_state, step=state.step + 1)
+                return state, (loss, *aux)
+
+            state, stats = jax.lax.scan(minibatch, state, mb_idx)
+            return (state, key), stats
+
+        (state, _), stats = jax.lax.scan(epoch, (state, key), None,
+                                         length=config.n_epochs)
+        metrics = PPOMetrics(
+            total_loss=jnp.mean(stats[0]), pg_loss=jnp.mean(stats[1]),
+            v_loss=jnp.mean(stats[2]), entropy=jnp.mean(stats[3]),
+            approx_kl=jnp.mean(stats[4]), clip_frac=jnp.mean(stats[5]),
+            mean_reward=jnp.mean(tr.reward), mean_value=jnp.mean(tr.value))
+        return state, carry, metrics
+
+    return member_step
+
+
+def make_population_step(apply_fn: PolicyApply, env_params: EnvParams,
+                         config: PPOConfig) -> Callable:
+    """vmap the member step over the stacked population axis:
+    (states[P], carries[P], traces, keys[P], hps[P]) ->
+    (states', carries', metrics[P]).
+
+    ``traces`` is NOT stacked per member (``in_axes=None``): every member
+    trains on the same env windows (PBT fitness must be comparable), so the
+    trace lives once — replicated across ``pop``, env-sharded over
+    ``data``."""
+    member = make_member_step(apply_fn, env_params, config)
+    return jax.vmap(member, in_axes=(0, 0, None, 0, 0))
+
+
+def population_shardings(mesh: Mesh):
+    """(member_state, carry, traces, keys, hps) shardings: member axis over
+    ``pop``, env axis over ``data`` — gradients never cross members, so the
+    only collective GSPMD inserts is the per-member env-batch reduction
+    within a ``pop`` row. Traces carry no member axis (see
+    make_population_step): env axis over ``data``, replicated over
+    ``pop``."""
+    pop = pop_sharded(mesh)
+    pop_env = pop_env_sharded(mesh)
+    state = MemberState(params=pop, opt_state=pop, step=pop)
+    carry = RolloutCarry(env_state=pop_env, obs=pop_env, mask=pop_env,
+                         key=pop)
+    hp = HParams(lr=pop, ent_coef=pop, clip_eps=pop)
+    return state, carry, env_sharded(mesh), pop, hp
+
+
+def jit_population_step(mesh: Mesh, pop_step: Callable) -> Callable:
+    state_sh, carry_sh, trace_sh, key_sh, hp_sh = population_shardings(mesh)
+    metrics_sh = jax.tree.map(lambda _: pop_sharded(mesh),
+                              PPOMetrics(*[0.0] * len(PPOMetrics._fields)))
+    return jax.jit(pop_step,
+                   in_shardings=(state_sh, carry_sh, trace_sh, key_sh, hp_sh),
+                   out_shardings=(state_sh, carry_sh, metrics_sh),
+                   donate_argnums=(0, 1))
+
+
+def stack_members(members: list) -> Any:
+    """Stack per-member pytrees into one [P, ...] pytree."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *members)
+
+
+def sample_hparams(base: PPOConfig, n_pop: int, seed: int,
+                   spread: float = 3.0) -> HParams:
+    """Initial population hyperparameters: log-uniform over
+    [base/spread, base*spread] around the config values (standard PBT
+    initialization), clipped to HPARAM_BOUNDS. Returns stacked [P] arrays."""
+    rng = np.random.default_rng(seed)
+
+    def draw(name: str, center: float) -> jnp.ndarray:
+        lo, hi = np.log(center / spread), np.log(center * spread)
+        vals = np.exp(rng.uniform(lo, hi, size=n_pop)).astype(np.float32)
+        return jnp.asarray(np.clip(vals, *HPARAM_BOUNDS[name]))
+
+    return HParams(lr=draw("lr", base.lr),
+                   ent_coef=draw("ent_coef", base.ent_coef),
+                   clip_eps=draw("clip_eps", base.clip_eps))

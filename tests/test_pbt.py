@@ -1,0 +1,183 @@
+"""Population training + PBT controller tests (SURVEY.md §2 "PBT
+controller", §3.5; §4 "Distributed without a real cluster" — pop-sharded
+member stacks run on the 8-device virtual CPU mesh)."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rlgpuschedule_tpu.algos import (PPOConfig, init_carry, make_ppo_step,
+                                     make_train_state)
+from rlgpuschedule_tpu.algos.ppo import make_optimizer
+from rlgpuschedule_tpu.configs import PPO_MLP_SYNTH64
+from rlgpuschedule_tpu.experiment import (Experiment, PopulationExperiment,
+                                          build_env_params,
+                                          load_source_trace,
+                                          make_env_windows)
+from rlgpuschedule_tpu.env import stack_traces
+from rlgpuschedule_tpu.models import make_policy
+from rlgpuschedule_tpu.parallel import (HParams, PBTConfig, PBTController,
+                                        exploit_explore, gather_members,
+                                        init_member, make_member_step,
+                                        make_mesh, sample_hparams,
+                                        stack_members)
+
+TINY = dataclasses.replace(
+    PPO_MLP_SYNTH64, n_nodes=2, gpus_per_node=4, n_envs=4, window_jobs=16,
+    horizon=64, ppo=PPOConfig(n_steps=8, n_epochs=1, n_minibatches=2))
+
+
+def _member_fixture(cfg=TINY):
+    env_params = build_env_params(cfg)
+    source = load_source_trace(cfg)
+    windows = make_env_windows(cfg, source)
+    traces = stack_traces(windows, env_params)
+    net = make_policy(cfg.obs_kind, env_params.n_actions,
+                      n_cluster_nodes=cfg.n_nodes, queue_len=cfg.queue_len,
+                      n_placements=cfg.n_placements)
+    apply_fn = lambda p, obs, mask: net.apply(p, obs, mask)
+    carry = init_carry(env_params, traces, jax.random.PRNGKey(1))
+    return env_params, traces, net, apply_fn, carry
+
+
+class TestHParams:
+    def test_sample_deterministic_and_bounded(self):
+        a = sample_hparams(PPOConfig(), 8, seed=3)
+        b = sample_hparams(PPOConfig(), 8, seed=3)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+        assert a.lr.shape == (8,)
+        assert (np.asarray(a.clip_eps) >= 0.05).all()
+        assert (np.asarray(a.clip_eps) <= 0.5).all()
+        assert (np.asarray(a.lr) > 0).all()
+
+    def test_spread_covers_range(self):
+        hp = sample_hparams(PPOConfig(lr=3e-4), 64, seed=0, spread=3.0)
+        lr = np.asarray(hp.lr)
+        assert lr.min() < 3e-4 < lr.max()
+
+
+class TestMemberStep:
+    def test_matches_single_run_ppo_at_config_hparams(self):
+        """A member stepped with hp == config values must reproduce the
+        plain PPO train step (optax.adam == scale_by_adam + scale(-lr))."""
+        cfg = TINY
+        env_params, traces, net, apply_fn, carry = _member_fixture(cfg)
+        key = jax.random.PRNGKey(7)
+        init_key = jax.random.PRNGKey(8)
+
+        ts = make_train_state(net, init_key, carry.obs[:1], carry.mask[:1],
+                              make_optimizer(cfg.ppo))
+        ppo_step = jax.jit(make_ppo_step(apply_fn, env_params, cfg.ppo))
+        ts2, _, _ = ppo_step(ts, carry, traces, key)
+
+        member = init_member(net, init_key, carry.obs[:1], carry.mask[:1],
+                             cfg.ppo)
+        hp = HParams(lr=jnp.float32(cfg.ppo.lr),
+                     ent_coef=jnp.float32(cfg.ppo.ent_coef),
+                     clip_eps=jnp.float32(cfg.ppo.clip_eps))
+        member_step = jax.jit(make_member_step(apply_fn, env_params, cfg.ppo))
+        m2, _, _ = member_step(member, carry, traces, key, hp)
+
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5,
+                                                    atol=1e-6),
+            ts2.params, m2.params)
+
+    def test_hparams_change_updates_without_recompile(self):
+        cfg = TINY
+        env_params, traces, net, apply_fn, carry = _member_fixture(cfg)
+        member = init_member(net, jax.random.PRNGKey(0), carry.obs[:1],
+                             carry.mask[:1], cfg.ppo)
+        step = jax.jit(make_member_step(apply_fn, env_params, cfg.ppo))
+        key = jax.random.PRNGKey(1)
+        hp_small = HParams(jnp.float32(1e-5), jnp.float32(0.01),
+                           jnp.float32(0.2))
+        hp_big = HParams(jnp.float32(1e-2), jnp.float32(0.01),
+                         jnp.float32(0.2))
+        a, _, _ = step(member, carry, traces, key, hp_small)
+        b, _, _ = step(member, carry, traces, key, hp_big)
+        diff_small = jax.tree_util.tree_reduce(
+            lambda acc, x: acc + float(jnp.abs(x).sum()),
+            jax.tree.map(lambda x, y: x - y, a.params, member.params), 0.0)
+        diff_big = jax.tree_util.tree_reduce(
+            lambda acc, x: acc + float(jnp.abs(x).sum()),
+            jax.tree.map(lambda x, y: x - y, b.params, member.params), 0.0)
+        assert diff_big > diff_small * 10
+
+
+class TestExploitExplore:
+    def _hp(self, n):
+        return HParams(lr=jnp.full((n,), 3e-4), ent_coef=jnp.full((n,), 0.01),
+                       clip_eps=jnp.full((n,), 0.2))
+
+    def test_losers_copy_winners(self):
+        rng = np.random.default_rng(0)
+        fitness = np.array([0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0])
+        d = exploit_explore(rng, fitness, self._hp(8),
+                            PBTConfig(exploit_frac=0.25))
+        # bottom 2 (members 0,1) copy from top 2 (members 6,7)
+        assert set(np.where(d.exploited)[0]) == {0, 1}
+        assert all(s in (6, 7) for s in d.src[:2])
+        np.testing.assert_array_equal(d.src[2:], np.arange(2, 8))
+
+    def test_explore_perturbs_only_exploited_within_bounds(self):
+        rng = np.random.default_rng(1)
+        fitness = np.arange(8.0)
+        hp = self._hp(8)
+        d = exploit_explore(rng, fitness, hp, PBTConfig())
+        lr = np.asarray(d.hparams.lr)
+        # survivors keep their hparams (up to f32 round-trip)
+        np.testing.assert_allclose(lr[~d.exploited], 3e-4, rtol=1e-6)
+        # exploited get parent value × {0.8, 1.25}
+        for i in np.where(d.exploited)[0]:
+            assert lr[i] == pytest.approx(3e-4 * 0.8, rel=1e-5) or \
+                   lr[i] == pytest.approx(3e-4 * 1.25, rel=1e-5)
+
+    def test_gather_members_copies_weights(self):
+        tree = {"w": jnp.arange(8.0), "b": jnp.arange(8.0) * 10}
+        src = np.array([7, 1, 2, 3, 4, 5, 6, 7])
+        out = gather_members(tree, src)
+        assert float(out["w"][0]) == 7.0
+        assert float(out["b"][0]) == 70.0
+        assert float(out["w"][1]) == 1.0
+
+    def test_controller_cadence(self):
+        ctrl = PBTController(4, PBTConfig(ready_iters=3))
+        hp = self._hp(4)
+        states = {"w": jnp.arange(4.0)}
+        for i in range(3):
+            ctrl.record(np.arange(4.0))
+            out = ctrl.maybe_update(i, states, hp)
+            if i < 2:
+                assert out is None
+        assert out is not None
+        _, _, decision = out
+        assert len(ctrl.history) == 1
+        # fitness window reset after the update
+        assert ctrl._fitness_n == 0
+
+
+class TestPopulationExperiment:
+    def test_end_to_end_with_pbt_on_mesh(self):
+        cfg = dataclasses.replace(
+            TINY, iterations=5,
+            ppo=PPOConfig(n_steps=8, n_epochs=1, n_minibatches=2))
+        mesh = make_mesh(n_pop=2)          # (2 pop, 4 data) over 8 cpu devs
+        exp = PopulationExperiment.build(
+            cfg, n_pop=4, mesh=mesh,
+            pbt_cfg=PBTConfig(ready_iters=2, seed=0))
+        out = exp.run(iterations=5, log_every=1)
+        assert out["pbt_events"] >= 1
+        assert len(out["final_fitness"]) == 4
+        assert all(np.isfinite(out["final_fitness"]))
+        for h in out["history"]:
+            assert all(np.isfinite(h["mean_reward"]))
+
+    def test_single_device_path(self):
+        cfg = dataclasses.replace(TINY, iterations=2)
+        exp = PopulationExperiment.build(cfg, n_pop=2, mesh=None)
+        out = exp.run(iterations=2)
+        assert out["env_steps"] == 2 * 8 * 4 * 2  # iters*T*E*P
