@@ -4,9 +4,11 @@ from .rollout import (Transition, RolloutCarry, PolicyApply, rollout,
 from .ppo import (PPOConfig, PPOMetrics, make_train_step as make_ppo_step,
                   make_train_state, ppo_loss, masked_entropy)
 from .a2c import A2CConfig, A2CMetrics, make_train_step as make_a2c_step
+from . import action_dist
 
 __all__ = [
     "Transition", "RolloutCarry", "PolicyApply", "rollout", "init_carry",
     "PPOConfig", "PPOMetrics", "make_ppo_step", "make_train_state",
     "ppo_loss", "masked_entropy", "A2CConfig", "A2CMetrics", "make_a2c_step",
+    "action_dist",
 ]

@@ -19,7 +19,7 @@ from flax.training.train_state import TrainState
 
 from ..env.env import EnvParams
 from ..ops.gae import compute_gae
-from .ppo import masked_entropy
+from . import action_dist
 from .rollout import PolicyApply, RolloutCarry, Transition, rollout
 
 
@@ -51,12 +51,10 @@ def make_optimizer(config: A2CConfig) -> optax.GradientTransformation:
 def a2c_loss(apply_fn: PolicyApply, net_params, batch: Transition,
              advantages: jax.Array, returns: jax.Array, config: A2CConfig):
     logits, value = apply_fn(net_params, batch.obs, batch.mask)
-    logp_all = jax.nn.log_softmax(logits)
-    log_prob = jnp.take_along_axis(logp_all, batch.action[:, None],
-                                   axis=1).squeeze(1)
+    log_prob = action_dist.log_prob(logits, batch.action)
     pg_loss = -jnp.mean(log_prob * advantages)
     v_loss = 0.5 * jnp.mean((value - returns) ** 2)
-    entropy = jnp.mean(masked_entropy(logits))
+    entropy = jnp.mean(action_dist.entropy(logits))
     total = pg_loss + config.vf_coef * v_loss - config.ent_coef * entropy
     return total, (pg_loss, v_loss, entropy)
 

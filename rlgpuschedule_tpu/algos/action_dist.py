@@ -1,0 +1,78 @@
+"""Multi-head masked-categorical action distribution over logit pytrees.
+
+Capability parity: SURVEY.md §2 "Actor/critic heads" (job-select ×
+placement logits) and "Hierarchical multi-agent" — the hierarchical agent's
+joint action factorizes into independent categorical heads (top-level
+router + per-pod placers, §3.5), so one distribution abstraction serves
+both the flat single-head policies (configs 1–4) and the factored
+hierarchical policy (config 5).
+
+Shape convention: a policy's ``logits`` may be a single ``[*B, A]`` array
+(one head) or any pytree of such arrays. All leaves share the leading
+batch axes ``*B``; a leaf may carry extra axes between batch and ``A``
+(e.g. the hierarchical policy's per-pod heads ``[*B, P, A]``) — each slice
+along those axes is an independent head, and joint log-probs/entropies sum
+them away. The batch rank is inferred as the minimum per-head rank across
+leaves (the single-head leaves anchor it; a policy with ONLY stacked-head
+leaves should add a size-1 head leaf or reshape). PPO/A2C and the rollout
+are written against these helpers and are head-structure-agnostic.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def split_like(key: jax.Array, tree: Any) -> Any:
+    """One PRNG key per tree leaf, packaged in the same structure."""
+    leaves, treedef = jax.tree.flatten(tree)
+    keys = jax.random.split(key, len(leaves))
+    return jax.tree.unflatten(treedef, list(keys))
+
+
+def _sum_heads(per_head: Any) -> jax.Array:
+    """Reduce per-head values [*B, *heads] to joint [*B]: batch rank =
+    minimum leaf rank; extra trailing axes are stacked heads, summed."""
+    leaves = jax.tree.leaves(per_head)
+    batch_ndim = min(l.ndim for l in leaves)
+    total = 0
+    for l in leaves:
+        if l.ndim > batch_ndim:
+            l = l.sum(axis=tuple(range(batch_ndim, l.ndim)))
+        total = total + l
+    return total
+
+
+def sample(key: jax.Array, logits: Any) -> tuple[Any, jax.Array]:
+    """Draw one action per head; returns (actions pytree of i32 arrays,
+    joint log-prob [*B]). Masked (−1e9) logits sample a masked action with
+    probability ~0."""
+    keys = split_like(key, logits)
+    actions = jax.tree.map(
+        lambda lg, k: jax.random.categorical(k, lg), logits, keys)
+    return actions, log_prob(logits, actions)
+
+
+def log_prob(logits: Any, actions: Any) -> jax.Array:
+    """Joint log-probability [*B] of an action pytree under a logits
+    pytree: selected-action log-softmax summed over all heads."""
+
+    def head_logp(lg: jax.Array, a: jax.Array) -> jax.Array:
+        logp = jax.nn.log_softmax(lg)
+        return jnp.take_along_axis(logp, a[..., None], axis=-1).squeeze(-1)
+
+    return _sum_heads(jax.tree.map(head_logp, logits, actions))
+
+
+def entropy(logits: Any) -> jax.Array:
+    """Joint entropy [*B] = sum of per-head masked-categorical entropies
+    (heads are independent). Masked entries (p≈0) contribute 0."""
+
+    def head_entropy(lg: jax.Array) -> jax.Array:
+        logp = jax.nn.log_softmax(lg)
+        p = jnp.exp(logp)
+        return -jnp.sum(p * jnp.where(p > 0, logp, 0.0), axis=-1)
+
+    return _sum_heads(jax.tree.map(head_entropy, logits))

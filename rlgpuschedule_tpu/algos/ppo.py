@@ -20,6 +20,7 @@ from flax.training.train_state import TrainState
 
 from ..env.env import EnvParams
 from ..ops.gae import compute_gae
+from . import action_dist
 from .rollout import PolicyApply, RolloutCarry, Transition, rollout
 
 
@@ -43,10 +44,9 @@ def make_optimizer(config: PPOConfig) -> optax.GradientTransformation:
 
 
 def masked_entropy(logits: jax.Array) -> jax.Array:
-    """Entropy of the masked categorical (−1e9 logits contribute ~0)."""
-    logp = jax.nn.log_softmax(logits)
-    p = jnp.exp(logp)
-    return -jnp.sum(p * jnp.where(p > 0, logp, 0.0), axis=-1)
+    """Entropy of the masked categorical (−1e9 logits contribute ~0).
+    Alias of :func:`action_dist.entropy` kept for the public API."""
+    return action_dist.entropy(logits)
 
 
 class PPOMetrics(NamedTuple):
@@ -70,9 +70,7 @@ def ppo_loss(apply_fn: PolicyApply, net_params, batch: Transition,
     clip_eps = config.clip_eps if clip_eps is None else clip_eps
     ent_coef = config.ent_coef if ent_coef is None else ent_coef
     logits, value = apply_fn(net_params, batch.obs, batch.mask)
-    logp_all = jax.nn.log_softmax(logits)
-    log_prob = jnp.take_along_axis(logp_all, batch.action[:, None],
-                                   axis=1).squeeze(1)
+    log_prob = action_dist.log_prob(logits, batch.action)
     ratio = jnp.exp(log_prob - batch.log_prob)
     pg1 = ratio * advantages
     pg2 = jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * advantages
@@ -82,12 +80,78 @@ def ppo_loss(apply_fn: PolicyApply, net_params, batch: Transition,
                                        -clip_eps, clip_eps)
     v_loss = 0.5 * jnp.mean(jnp.maximum((value - returns) ** 2,
                                         (v_clipped - returns) ** 2))
-    entropy = jnp.mean(masked_entropy(logits))
+    entropy = jnp.mean(action_dist.entropy(logits))
     total = pg_loss + config.vf_coef * v_loss - ent_coef * entropy
     approx_kl = jnp.mean(batch.log_prob - log_prob)
     clip_frac = jnp.mean((jnp.abs(ratio - 1.0) > clip_eps)
                          .astype(jnp.float32))
     return total, (pg_loss, v_loss, entropy, approx_kl, clip_frac)
+
+
+def normalize_advantages(advantages: jax.Array,
+                         axis_name: str | None = None) -> jax.Array:
+    """Normalize over the full batch (global across the mesh axis so DP
+    replicas agree on the statistics). Global variance must be
+    E[x²] − (E[x])² over globally-reduced moments — a pmean of per-shard
+    variances would drop the between-shard term."""
+    adv_mean = jnp.mean(advantages)
+    adv_sq = jnp.mean(advantages ** 2)
+    if axis_name is not None:
+        adv_mean = jax.lax.pmean(adv_mean, axis_name)
+        adv_sq = jax.lax.pmean(adv_sq, axis_name)
+    adv_var = adv_sq - adv_mean ** 2
+    return (advantages - adv_mean) / jnp.sqrt(adv_var + 1e-8)
+
+
+def run_ppo_epochs(apply_fn: PolicyApply, config: PPOConfig, state,
+                   tr: Transition, advantages: jax.Array,
+                   returns: jax.Array, key: jax.Array, apply_grads,
+                   clip_eps=None, ent_coef=None):
+    """The PPO update core shared by the single-run trainer and the PBT
+    member step: flatten [T, E] → [B], then epoch × shuffled-minibatch
+    ``lax.scan``s of clipped-surrogate updates. ``apply_grads(state,
+    grads) -> state`` injects the optimizer strategy (TrainState vs the
+    population's manual traced-lr update); ``clip_eps``/``ent_coef``
+    optionally override the config with traced values. Returns
+    (state, metrics)."""
+    B = config.n_steps * tr.reward.shape[1]
+    flat = jax.tree.map(lambda x: x.reshape(B, *x.shape[2:]), tr)
+    adv_flat = advantages.reshape(B)
+    ret_flat = returns.reshape(B)
+    mb_size = B // config.n_minibatches
+    assert mb_size * config.n_minibatches == B, \
+        "n_steps * n_envs must be divisible by n_minibatches"
+
+    def epoch(state_and_key, _):
+        state, key = state_and_key
+        key, sub = jax.random.split(key)
+        perm = jax.random.permutation(sub, B)
+        mb_idx = perm.reshape(config.n_minibatches, mb_size)
+
+        def minibatch(state, idx):
+            mb = jax.tree.map(lambda x: x[idx], flat)
+            (loss, aux), grads = jax.value_and_grad(
+                ppo_loss, argnums=1, has_aux=True)(
+                apply_fn, _params_of(state), mb, adv_flat[idx],
+                ret_flat[idx], config, clip_eps=clip_eps, ent_coef=ent_coef)
+            state = apply_grads(state, grads)
+            return state, (loss, *aux)
+
+        state, stats = jax.lax.scan(minibatch, state, mb_idx)
+        return (state, key), stats
+
+    (state, _), stats = jax.lax.scan(epoch, (state, key), None,
+                                     length=config.n_epochs)
+    metrics = PPOMetrics(
+        total_loss=jnp.mean(stats[0]), pg_loss=jnp.mean(stats[1]),
+        v_loss=jnp.mean(stats[2]), entropy=jnp.mean(stats[3]),
+        approx_kl=jnp.mean(stats[4]), clip_frac=jnp.mean(stats[5]),
+        mean_reward=jnp.mean(tr.reward), mean_value=jnp.mean(tr.value))
+    return state, metrics
+
+
+def _params_of(state):
+    return state.params  # TrainState and population.MemberState both
 
 
 def make_train_step(apply_fn: PolicyApply, env_params: EnvParams,
@@ -98,6 +162,11 @@ def make_train_step(apply_fn: PolicyApply, env_params: EnvParams,
     ``axis_name``: mesh axis for data-parallel gradient pmean (None =
     single-device)."""
 
+    def apply_grads(state: TrainState, grads):
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+        return state.apply_gradients(grads=grads)
+
     def train_step(train_state: TrainState, carry: RolloutCarry, traces,
                    key: jax.Array):
         carry, tr, last_value = rollout(apply_fn, train_state.params,
@@ -106,54 +175,10 @@ def make_train_step(apply_fn: PolicyApply, env_params: EnvParams,
         advantages, returns = compute_gae(tr.reward, tr.value, tr.done,
                                           last_value, config.gamma,
                                           config.gae_lambda)
-        # normalize advantages over the full batch (global across the mesh
-        # axis so DP replicas agree on the statistics). Global variance must
-        # be E[x²] − (E[x])² over globally-reduced moments — a pmean of
-        # per-shard variances would drop the between-shard term.
-        adv_mean = jnp.mean(advantages)
-        adv_sq = jnp.mean(advantages ** 2)
-        if axis_name is not None:
-            adv_mean = jax.lax.pmean(adv_mean, axis_name)
-            adv_sq = jax.lax.pmean(adv_sq, axis_name)
-        adv_var = adv_sq - adv_mean ** 2
-        advantages = (advantages - adv_mean) / jnp.sqrt(adv_var + 1e-8)
-
-        B = config.n_steps * tr.reward.shape[1]
-        flat = jax.tree.map(lambda x: x.reshape(B, *x.shape[2:]), tr)
-        adv_flat = advantages.reshape(B)
-        ret_flat = returns.reshape(B)
-        mb_size = B // config.n_minibatches
-        assert mb_size * config.n_minibatches == B, \
-            "n_steps * n_envs must be divisible by n_minibatches"
-
-        def epoch(state_and_key, _):
-            state, key = state_and_key
-            key, sub = jax.random.split(key)
-            perm = jax.random.permutation(sub, B)
-            mb_idx = perm.reshape(config.n_minibatches, mb_size)
-
-            def minibatch(state, idx):
-                mb = jax.tree.map(lambda x: x[idx], flat)
-                (loss, aux), grads = jax.value_and_grad(
-                    ppo_loss, argnums=1, has_aux=True)(
-                    apply_fn, state.params, mb, adv_flat[idx], ret_flat[idx],
-                    config)
-                if axis_name is not None:
-                    grads = jax.lax.pmean(grads, axis_name)
-                state = state.apply_gradients(grads=grads)
-                return state, (loss, *aux)
-
-            state, stats = jax.lax.scan(minibatch, state, mb_idx)
-            return (state, key), stats
-
-        (train_state, _), stats = jax.lax.scan(
-            epoch, (train_state, key), None, length=config.n_epochs)
-        mean = lambda x: jnp.mean(x)
-        metrics = PPOMetrics(
-            total_loss=mean(stats[0]), pg_loss=mean(stats[1]),
-            v_loss=mean(stats[2]), entropy=mean(stats[3]),
-            approx_kl=mean(stats[4]), clip_frac=mean(stats[5]),
-            mean_reward=jnp.mean(tr.reward), mean_value=jnp.mean(tr.value))
+        advantages = normalize_advantages(advantages, axis_name)
+        train_state, metrics = run_ppo_epochs(
+            apply_fn, config, train_state, tr, advantages, returns, key,
+            apply_grads)
         return train_state, carry, metrics
 
     return train_step

@@ -17,21 +17,26 @@ import jax.numpy as jnp
 
 from ..env import env as env_lib
 from ..env.env import EnvParams, EnvState, TimeStep
+from . import action_dist
 
-# (net_params, obs[E,...], mask[E,A]) -> (masked_logits[E,A], value[E])
-PolicyApply = Callable[[Any, jax.Array, jax.Array],
-                       tuple[jax.Array, jax.Array]]
+# (net_params, obs, mask) -> (masked_logits, value[E]). obs/mask/logits may
+# each be a single array or a pytree (multi-head policies — see
+# algos.action_dist); the rollout is agnostic.
+PolicyApply = Callable[[Any, Any, Any], tuple[Any, jax.Array]]
 
 
 class Transition(NamedTuple):
-    """One scan slice of the rollout buffer; stacked to [T, E, ...]."""
-    obs: jax.Array
-    action: jax.Array
+    """One scan slice of the rollout buffer; stacked to [T, E, ...].
+    ``obs``/``action``/``mask`` are arrays for single-head policies and
+    pytrees for multi-head (hierarchical) ones; ``log_prob`` is always the
+    joint [E] log-prob."""
+    obs: Any
+    action: Any
     log_prob: jax.Array
     value: jax.Array
     reward: jax.Array
     done: jax.Array
-    mask: jax.Array
+    mask: Any
     env_steps_dt: jax.Array  # simulated seconds advanced (metrics)
 
 
@@ -56,9 +61,7 @@ def rollout(apply_fn: PolicyApply, net_params, env_params: EnvParams,
     def step(c: RolloutCarry, _):
         logits, value = apply_fn(net_params, c.obs, c.mask)
         key, sub = jax.random.split(c.key)
-        action = jax.random.categorical(sub, logits)
-        log_prob = jnp.take_along_axis(
-            jax.nn.log_softmax(logits), action[:, None], axis=1).squeeze(1)
+        action, log_prob = action_dist.sample(sub, logits)
         env_state, ts = env_lib.vec_step(env_params, c.env_state, traces, action)
         t = Transition(obs=c.obs, action=action, log_prob=log_prob,
                        value=value, reward=ts.reward, done=ts.done,

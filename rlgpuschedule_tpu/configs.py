@@ -30,6 +30,7 @@ class ExperimentConfig:
     n_envs: int = 4
     queue_len: int = 8
     n_placements: int = 1
+    n_pods: int = 1                     # >1 = hierarchical env (config 5)
     obs_kind: Literal["flat", "grid", "graph"] = "flat"
     reward_kind: Literal["jct", "fair"] = "jct"
     n_tenants: int = 1
@@ -80,9 +81,11 @@ GNN_GANG_PLACE = _register(ExperimentConfig(
     trace="synthetic", n_envs=4, obs_kind="graph", n_placements=2,
     nodes_per_rack=4, window_jobs=64))
 
-# 5. Hierarchical multi-agent across 4 pods + PBT: this is the per-member
-# training config that the population/hierarchy machinery (parallel/) runs
-# many copies of.
+# 5. Hierarchical multi-agent across 4 pods + PBT: each population member
+# IS a hierarchical agent (top-level router + shared per-pod placers) over
+# a 4-pod cluster; PopulationExperiment runs a PBT population of these
+# (parallel.population / parallel.pbt).
 HIER_PBT_MEMBER = _register(ExperimentConfig(
-    name="hier-pbt-member", algo="ppo", n_nodes=8, gpus_per_node=8,
-    trace="synthetic", n_envs=4, obs_kind="flat", window_jobs=64))
+    name="hier-pbt-member", algo="ppo", n_nodes=16, gpus_per_node=8,
+    n_pods=4, trace="synthetic", n_envs=4, obs_kind="flat",
+    window_jobs=64))

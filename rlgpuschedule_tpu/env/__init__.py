@@ -3,10 +3,12 @@ from .env import (EnvParams, EnvState, TimeStep, reset, step, auto_reset_step,
                   stack_traces, vec_reset, vec_step, build_obs)
 from .obs import flat_obs, grid_obs, graph_obs, build_adjacency, GRAPH_FEATURES
 from .rewards import reward_jct, reward_fair, tenant_counts
+from .hier import HierParams, HierState
 
 __all__ = [
     "EnvParams", "EnvState", "TimeStep", "reset", "step", "auto_reset_step",
     "stack_traces", "vec_reset", "vec_step", "build_obs",
     "flat_obs", "grid_obs", "graph_obs", "build_adjacency", "GRAPH_FEATURES",
     "reward_jct", "reward_fair", "tenant_counts",
+    "HierParams", "HierState",
 ]

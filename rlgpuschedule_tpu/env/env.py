@@ -13,7 +13,8 @@ so the whole interaction loop fuses into one ``lax.scan`` with the policy
 from __future__ import annotations
 
 import dataclasses
-from typing import Literal, NamedTuple
+import functools
+from typing import Any, Literal, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -102,18 +103,25 @@ def step(params: EnvParams, state: EnvState, trace: Trace,
     return new_state, ts
 
 
+def auto_reset(stepped_state, ts: TimeStep, fresh_state, fresh_ts: TimeStep,
+               ) -> tuple[Any, TimeStep]:
+    """Blend a stepped (state, timestep) with a fresh reset on episode end
+    (obs/mask from the fresh episode, reward/done from the finished one) —
+    the standard fused auto-reset so rollouts never leave the device.
+    obs/mask may be pytrees (hierarchical env)."""
+    pick = lambda a, b: jax.tree.map(
+        lambda x, y: jnp.where(ts.done, x, y), a, b)
+    new_state = pick(fresh_state, stepped_state)
+    obs = pick(fresh_ts.obs, ts.obs)
+    mask = pick(fresh_ts.action_mask, ts.action_mask)
+    return new_state, ts._replace(obs=obs, action_mask=mask)
+
+
 def auto_reset_step(params: EnvParams, state: EnvState, trace: Trace,
                     action: jax.Array) -> tuple[EnvState, TimeStep]:
-    """Step, and on episode end return the reset state (obs/mask from the
-    fresh episode, reward/done from the finished one) — the standard fused
-    auto-reset so rollouts never leave the device."""
     stepped, ts = step(params, state, trace, action)
     fresh, fresh_ts = reset(params, trace)
-    new_state = jax.tree.map(lambda a, b: jnp.where(ts.done, a, b),
-                             fresh, stepped)
-    obs = jnp.where(ts.done, fresh_ts.obs, ts.obs)
-    mask = jnp.where(ts.done, fresh_ts.action_mask, ts.action_mask)
-    return new_state, ts._replace(obs=obs, action_mask=mask)
+    return auto_reset(stepped, ts, fresh, fresh_ts)
 
 
 # ---- vectorization ----------------------------------------------------------
@@ -129,11 +137,27 @@ def stack_traces(traces: list[ArrayTrace],
     return jax.tree.map(lambda *xs: jnp.stack(xs), *devs)
 
 
-def vec_reset(params: EnvParams, traces: Trace) -> tuple[EnvState, TimeStep]:
+@functools.singledispatch
+def vec_reset(params, traces: Trace) -> tuple[Any, TimeStep]:
+    """Vectorized reset, dispatched on the params type (EnvParams here;
+    env.hier registers HierParams) so the rollout/algorithms layer is
+    env-agnostic."""
+    raise TypeError(f"no env registered for params type {type(params)}")
+
+
+@functools.singledispatch
+def vec_step(params, state, traces: Trace, actions) -> tuple[Any, TimeStep]:
+    """Vectorized auto-reset step, dispatched on the params type."""
+    raise TypeError(f"no env registered for params type {type(params)}")
+
+
+@vec_reset.register
+def _(params: EnvParams, traces: Trace) -> tuple[EnvState, TimeStep]:
     return jax.vmap(lambda tr: reset(params, tr))(traces)
 
 
-def vec_step(params: EnvParams, state: EnvState, traces: Trace,
-             actions: jax.Array) -> tuple[EnvState, TimeStep]:
+@vec_step.register
+def _(params: EnvParams, state: EnvState, traces: Trace,
+      actions: jax.Array) -> tuple[EnvState, TimeStep]:
     return jax.vmap(lambda s, tr, a: auto_reset_step(params, s, tr, a)
                     )(state, traces, actions)

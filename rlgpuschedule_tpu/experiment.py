@@ -56,7 +56,30 @@ def build_stack(cfg: ExperimentConfig):
     load/validate/window/stack + policy net + (obs, mask) apply closure.
     Returns (env_params, windows, traces [E, ...], net, apply_fn, extra)
     where ``extra`` are the apply args between obs and mask (the GNN's
-    adjacency)."""
+    adjacency). ``cfg.n_pods > 1`` selects the hierarchical env + policy
+    (config 5) — env_params is then a ``env.hier.HierParams``."""
+    if cfg.n_pods > 1:
+        from .env import hier as hier_lib   # registers the vec dispatch
+        from .models.hier import HierActorCritic
+        if cfg.n_nodes % cfg.n_pods != 0:
+            raise ValueError(f"n_nodes={cfg.n_nodes} not divisible by "
+                             f"n_pods={cfg.n_pods}")
+        pod_sim = SimParams(n_nodes=cfg.n_nodes // cfg.n_pods,
+                            gpus_per_node=cfg.gpus_per_node,
+                            max_jobs=cfg.window_jobs,
+                            queue_len=cfg.queue_len,
+                            n_placements=cfg.n_placements)
+        env_params = hier_lib.HierParams(
+            n_pods=cfg.n_pods, pod_sim=pod_sim, time_scale=cfg.time_scale,
+            reward_scale=cfg.reward_scale, horizon=cfg.horizon)
+        source = validate_trace(pod_sim, load_source_trace(cfg), clamp=True)
+        windows = make_env_windows(cfg, source)
+        traces = stack_traces(windows, pod_sim)
+        net = HierActorCritic(n_top_actions=env_params.n_top_actions,
+                              n_pod_actions=pod_sim.n_actions)
+        apply_fn = lambda p, obs, mask: net.apply(p, obs, mask)
+        return env_params, windows, traces, net, apply_fn, ()
+
     env_params = build_env_params(cfg)
     source = validate_trace(env_params.sim, load_source_trace(cfg),
                             clamp=True)
@@ -121,8 +144,10 @@ class Experiment:
             tx = a2c_opt(algo_cfg)
             step_fn = make_a2c_step(apply_fn, env_params, algo_cfg, axis_name)
         carry = init_carry(env_params, traces, carry_key)
-        train_state = make_train_state(net, init_key, carry.obs[:1],
-                                       carry.mask[:1], tx, extra)
+        ex_obs, ex_mask = jax.tree.map(lambda x: x[:1],
+                                       (carry.obs, carry.mask))
+        train_state = make_train_state(net, init_key, ex_obs, ex_mask, tx,
+                                       extra)
         if jit:
             if axis_name is not None:
                 # pmean(axis_name) is unbound under plain jit — callers using
@@ -232,9 +257,10 @@ class PopulationExperiment:
         members, carries = [], []
         for p in range(n_pop):
             carry = init_carry(env_params, traces, member_keys[p, 1])
-            members.append(init_member(net, member_keys[p, 0],
-                                       carry.obs[:1], carry.mask[:1],
-                                       cfg.ppo, extra))
+            ex_obs, ex_mask = jax.tree.map(lambda x: x[:1],
+                                           (carry.obs, carry.mask))
+            members.append(init_member(net, member_keys[p, 0], ex_obs,
+                                       ex_mask, cfg.ppo, extra))
             carries.append(carry)
         states = stack_members(members)
         stacked_carries = stack_members(carries)
@@ -246,6 +272,9 @@ class PopulationExperiment:
             if n_pop % mesh.shape["pop"] != 0:
                 raise ValueError(f"n_pop={n_pop} not divisible by pop axis "
                                  f"size {mesh.shape['pop']}")
+            if cfg.n_envs % mesh.shape["data"] != 0:
+                raise ValueError(f"n_envs={cfg.n_envs} not divisible by "
+                                 f"data axis size {mesh.shape['data']}")
             jitted = jit_population_step(mesh, pop_step)
             from .parallel.population import population_shardings
             st_sh, ca_sh, tr_sh, key_sh, hp_sh = population_shardings(mesh)

@@ -2,6 +2,8 @@
 from .encoders import MLPEncoder, CNNEncoder, GNNEncoder
 from .actor_critic import (ActorCritic, GNNActorCritic, make_policy,
                            mask_logits, NEG_INF)
+from .hier import HierActorCritic
 
 __all__ = ["MLPEncoder", "CNNEncoder", "GNNEncoder", "ActorCritic",
-           "GNNActorCritic", "make_policy", "mask_logits", "NEG_INF"]
+           "GNNActorCritic", "make_policy", "mask_logits", "NEG_INF",
+           "HierActorCritic"]

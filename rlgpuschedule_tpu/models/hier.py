@@ -1,0 +1,54 @@
+"""Hierarchical actor-critic (L3) — config 5's policy.
+
+Capability parity: SURVEY.md §2 "Hierarchical multi-agent" / §3.5 "top
+scheduler ↔ per-pod schedulers": one Flax module holds the top-level
+router head and the per-pod placement head. The pod trunk's weights are
+SHARED across pods (flax ``Dense`` broadcasts over the pod axis, so all P
+pod forwards are one batched MXU matmul — the TPU-native replacement for
+the reference's per-pod agent processes); the router sees its own summary
+observation plus the pooled pod embeddings. A single critic values the
+joint state (the factored heads optimize one joint PPO objective via
+``algos.action_dist``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from .actor_critic import mask_logits
+from .encoders import MLPEncoder
+
+
+class HierActorCritic(nn.Module):
+    """``apply(params, obs, mask) -> (logits, value)`` with
+    ``obs = {"top": [*B, Dt], "pods": [*B, P, Dp]}``,
+    ``mask = {"top": [*B, P+1], "pods": [*B, P, A]}``,
+    ``logits = {"top": [*B, P+1], "pods": [*B, P, A]}`` (see
+    algos.action_dist for the stacked-head convention)."""
+    n_top_actions: int
+    n_pod_actions: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, obs: dict, mask: dict
+                 ) -> tuple[dict, jax.Array]:
+        top_h = MLPEncoder(dtype=self.dtype, name="top_trunk")(obs["top"])
+        pod_h = MLPEncoder(dtype=self.dtype, name="pod_trunk")(obs["pods"])
+        pooled = pod_h.mean(axis=-2)
+        joint = jnp.concatenate([top_h, pooled], axis=-1)
+        top_logits = nn.Dense(self.n_top_actions, dtype=jnp.float32,
+                              kernel_init=nn.initializers.orthogonal(0.01),
+                              name="top_policy")(joint)
+        pod_logits = nn.Dense(self.n_pod_actions, dtype=jnp.float32,
+                              kernel_init=nn.initializers.orthogonal(0.01),
+                              name="pod_policy")(pod_h)
+        value = nn.Dense(1, dtype=jnp.float32,
+                         kernel_init=nn.initializers.orthogonal(1.0),
+                         name="value")(joint)
+        logits = {
+            "top": mask_logits(top_logits.astype(jnp.float32), mask["top"]),
+            "pods": mask_logits(pod_logits.astype(jnp.float32),
+                                mask["pods"]),
+        }
+        return logits, value.squeeze(-1)

@@ -27,7 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ..algos.ppo import PPOConfig, PPOMetrics, ppo_loss
+from ..algos.ppo import (PPOConfig, PPOMetrics, normalize_advantages,
+                         run_ppo_epochs)
 from ..algos.rollout import PolicyApply, RolloutCarry, rollout
 from ..env.env import EnvParams
 from ..ops.gae import compute_gae
@@ -79,9 +80,10 @@ def make_member_step(apply_fn: PolicyApply, env_params: EnvParams,
                      config: PPOConfig) -> Callable:
     """One member's PPO iteration with traced hyperparameters:
     (member_state, carry, traces, key, hp) -> (member_state', carry',
-    metrics). Mirrors ``algos.ppo.make_train_step`` (see its docstring for
-    the scan structure) with hp.{clip_eps, ent_coef} fed into the loss and
-    hp.lr applied to the adam-preconditioned updates."""
+    metrics). The update core is ``algos.ppo.run_ppo_epochs`` with
+    hp.{clip_eps, ent_coef} fed into the loss and hp.lr applied to the
+    adam-preconditioned updates (so optax.adam == scale_by_adam + our
+    scale is preserved exactly when hp matches the config)."""
     tx = make_member_tx(config)
 
     def member_step(state: MemberState, carry: RolloutCarry, traces,
@@ -91,50 +93,19 @@ def make_member_step(apply_fn: PolicyApply, env_params: EnvParams,
         advantages, returns = compute_gae(tr.reward, tr.value, tr.done,
                                           last_value, config.gamma,
                                           config.gae_lambda)
-        # same moment-form normalization as algos.ppo.make_train_step so a
-        # member with hp == config reproduces the single-run step bit-close
-        adv_mean = jnp.mean(advantages)
-        adv_var = jnp.mean(advantages ** 2) - adv_mean ** 2
-        advantages = (advantages - adv_mean) / jnp.sqrt(adv_var + 1e-8)
+        advantages = normalize_advantages(advantages)
 
-        B = config.n_steps * tr.reward.shape[1]
-        flat = jax.tree.map(lambda x: x.reshape(B, *x.shape[2:]), tr)
-        adv_flat = advantages.reshape(B)
-        ret_flat = returns.reshape(B)
-        mb_size = B // config.n_minibatches
-        assert mb_size * config.n_minibatches == B, \
-            "n_steps * n_envs must be divisible by n_minibatches"
+        def apply_grads(state: MemberState, grads) -> MemberState:
+            updates, opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+            updates = jax.tree.map(lambda u: -hp.lr * u, updates)
+            return MemberState(
+                params=optax.apply_updates(state.params, updates),
+                opt_state=opt_state, step=state.step + 1)
 
-        def epoch(state_and_key, _):
-            state, key = state_and_key
-            key, sub = jax.random.split(key)
-            perm = jax.random.permutation(sub, B)
-            mb_idx = perm.reshape(config.n_minibatches, mb_size)
-
-            def minibatch(state: MemberState, idx):
-                mb = jax.tree.map(lambda x: x[idx], flat)
-                (loss, aux), grads = jax.value_and_grad(
-                    ppo_loss, argnums=1, has_aux=True)(
-                    apply_fn, state.params, mb, adv_flat[idx], ret_flat[idx],
-                    config, clip_eps=hp.clip_eps, ent_coef=hp.ent_coef)
-                updates, opt_state = tx.update(grads, state.opt_state,
-                                               state.params)
-                updates = jax.tree.map(lambda u: -hp.lr * u, updates)
-                state = MemberState(
-                    params=optax.apply_updates(state.params, updates),
-                    opt_state=opt_state, step=state.step + 1)
-                return state, (loss, *aux)
-
-            state, stats = jax.lax.scan(minibatch, state, mb_idx)
-            return (state, key), stats
-
-        (state, _), stats = jax.lax.scan(epoch, (state, key), None,
-                                         length=config.n_epochs)
-        metrics = PPOMetrics(
-            total_loss=jnp.mean(stats[0]), pg_loss=jnp.mean(stats[1]),
-            v_loss=jnp.mean(stats[2]), entropy=jnp.mean(stats[3]),
-            approx_kl=jnp.mean(stats[4]), clip_frac=jnp.mean(stats[5]),
-            mean_reward=jnp.mean(tr.reward), mean_value=jnp.mean(tr.value))
+        state, metrics = run_ppo_epochs(
+            apply_fn, config, state, tr, advantages, returns, key,
+            apply_grads, clip_eps=hp.clip_eps, ent_coef=hp.ent_coef)
         return state, carry, metrics
 
     return member_step

@@ -1,0 +1,199 @@
+"""Hierarchical multi-pod env + factored policy tests (SURVEY.md §2
+"Hierarchical multi-agent", §3.5 — config 5)."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rlgpuschedule_tpu.algos import PPOConfig, action_dist
+from rlgpuschedule_tpu.configs import HIER_PBT_MEMBER
+from rlgpuschedule_tpu.env import hier
+from rlgpuschedule_tpu.env.hier import HierParams
+from rlgpuschedule_tpu.experiment import Experiment, PopulationExperiment
+from rlgpuschedule_tpu.parallel import PBTConfig, make_mesh
+from rlgpuschedule_tpu.sim.core import (PENDING, RUNNING, SimParams, Trace)
+from rlgpuschedule_tpu.traces.records import JobRecord, to_array_trace
+
+
+def make_params(n_pods=2, nodes=1, gpus=4, max_jobs=8, queue_len=4):
+    return HierParams(n_pods=n_pods,
+                      pod_sim=SimParams(n_nodes=nodes, gpus_per_node=gpus,
+                                        max_jobs=max_jobs,
+                                        queue_len=queue_len),
+                      reward_scale=100.0, horizon=64)
+
+
+def tiny_trace(max_jobs=8):
+    """Two 2-GPU jobs at t=0 (duration 100, 50) + one at t=10."""
+    return to_array_trace(
+        [JobRecord(0, 0.0, 100.0, 2), JobRecord(1, 0.0, 50.0, 2),
+         JobRecord(2, 10.0, 30.0, 2)], max_jobs=max_jobs)
+
+
+def dev_trace(tr, params):
+    return Trace.from_array_trace(tr, params.pod_sim)
+
+
+NOOP_TOP = lambda p: jnp.int32(p.n_pods)
+
+
+def noop_actions(p):
+    return {"top": NOOP_TOP(p),
+            "pods": jnp.full((p.n_pods,), p.pod_sim.n_actions - 1,
+                             jnp.int32)}
+
+
+class TestActionDist:
+    def test_multi_head_log_prob_and_entropy(self):
+        logits = {"top": jnp.zeros((5, 3)), "pods": jnp.zeros((5, 2, 4))}
+        actions = {"top": jnp.zeros((5,), jnp.int32),
+                   "pods": jnp.zeros((5, 2), jnp.int32)}
+        lp = action_dist.log_prob(logits, actions)
+        assert lp.shape == (5,)
+        np.testing.assert_allclose(
+            lp, np.log(1 / 3) + 2 * np.log(1 / 4), rtol=1e-6)
+        ent = action_dist.entropy(logits)
+        np.testing.assert_allclose(ent, np.log(3) + 2 * np.log(4),
+                                   rtol=1e-6)
+
+    def test_single_head_matches_old_semantics(self):
+        logits = jnp.array([[0.0, jnp.log(3.0)]])
+        a = jnp.array([1], jnp.int32)
+        lp = action_dist.log_prob(logits, a)
+        np.testing.assert_allclose(lp, np.log(0.75), rtol=1e-6)
+
+    def test_sample_respects_mask(self):
+        logits = {"top": jnp.array([[-1e9, 0.0, -1e9]]),
+                  "pods": jnp.array([[[0.0, -1e9]]])}
+        for seed in range(5):
+            acts, _ = action_dist.sample(jax.random.PRNGKey(seed), logits)
+            assert int(acts["top"][0]) == 1
+            assert int(acts["pods"][0, 0]) == 0
+
+
+class TestHierMechanics:
+    def test_reset_shapes_and_masks(self):
+        p = make_params()
+        tr = dev_trace(tiny_trace(), p)
+        state, ts = hier.reset(p, tr)
+        assert ts.obs["top"].shape == p.obs_shape()["top"]
+        assert ts.obs["pods"].shape == p.obs_shape()["pods"]
+        assert ts.action_mask["top"].shape == (p.n_pods + 1,)
+        # jobs 0,1 arrived at t=0 → routing to either pod is legal
+        assert bool(ts.action_mask["top"][0]) and bool(ts.action_mask["top"][1])
+        assert int(state.assignment[0]) == -1
+
+    def test_route_assigns_head_to_pod(self):
+        p = make_params()
+        tr = dev_trace(tiny_trace(), p)
+        state, _ = hier.reset(p, tr)
+        a = noop_actions(p) | {"top": jnp.int32(1)}
+        state, ts = hier.step(p, state, tr, a)
+        assert int(state.assignment[0]) == 1          # head = earliest submit
+        assert int(state.pods.status[1, 0]) == PENDING
+        assert float(ts.info.dt) == 0.0               # routing costs no time
+
+    def test_pod_places_routed_job(self):
+        p = make_params()
+        tr = dev_trace(tiny_trace(), p)
+        state, _ = hier.reset(p, tr)
+        state, _ = hier.step(p, state, tr,
+                             noop_actions(p) | {"top": jnp.int32(0)})
+        acts = noop_actions(p)
+        acts["pods"] = acts["pods"].at[0].set(0)      # pod 0: place slot 0
+        state, _ = hier.step(p, state, tr, acts)
+        assert int(state.pods.status[0, 0]) == RUNNING
+        assert int(jnp.sum(state.pods.free[0])) == p.pod_capacity - 2
+        # conservation in the untouched pod
+        assert int(jnp.sum(state.pods.free[1])) == p.pod_capacity
+
+    def test_noop_advances_to_completion(self):
+        p = make_params()
+        tr = dev_trace(tiny_trace(), p)
+        state, _ = hier.reset(p, tr)
+        state, _ = hier.step(p, state, tr,
+                             noop_actions(p) | {"top": jnp.int32(0)})
+        acts = noop_actions(p)
+        acts["pods"] = acts["pods"].at[0].set(0)
+        state, _ = hier.step(p, state, tr, acts)
+        # all no-op: next event is job 2's arrival at t=10
+        state, ts = hier.step(p, state, tr, noop_actions(p))
+        assert float(hier.global_clock(state)) == pytest.approx(10.0)
+        assert float(ts.info.dt) == pytest.approx(10.0)
+        # reward = -dt * in_system_before / scale; jobs 0,1 in system
+        assert float(ts.reward) == pytest.approx(-10.0 * 2 / 100.0)
+
+    def test_forced_progress_routes_when_idle(self):
+        p = make_params()
+        tr = dev_trace(tiny_trace(), p)
+        state, _ = hier.reset(p, tr)
+        # advance past all arrivals with nothing running: repeated no-ops
+        # must eventually force-route and force-place rather than deadlock
+        for _ in range(12):
+            state, ts = hier.step(p, state, tr, noop_actions(p))
+        assert int(jnp.sum(state.assignment >= 0)) == 3
+        assert bool(ts.done) or int(jnp.sum(
+            (state.pods.status == RUNNING))) > 0
+
+    def test_episode_completes_and_jct(self):
+        """Route both t=0 jobs to different pods, place immediately: both
+        run in parallel; job 2 (t=10, dur 30) finishes at 40. Hand-checked
+        JCTs: 100, 50, 30."""
+        p = make_params()
+        tr = dev_trace(tiny_trace(), p)
+        state, ts = hier.reset(p, tr)
+        done = False
+        for i in range(40):
+            mask = hier.action_mask(p, state, tr)
+            # greedy: route head to pod with most free GPUs; pods place
+            # their queue head whenever legal
+            pod_free = jnp.sum(state.pods.free, axis=1)
+            top = jnp.where(jnp.any(mask["top"][:p.n_pods]),
+                            jnp.argmax(pod_free), p.n_pods)
+            pods = jnp.where(mask["pods"][:, 0], 0, p.pod_sim.n_actions - 1)
+            state, ts = hier.step(p, state, tr,
+                                  {"top": jnp.int32(top),
+                                   "pods": pods.astype(jnp.int32)})
+            if bool(ts.done):
+                done = True
+                break
+        assert done
+        stats = hier.jct_stats(state, tr)
+        assert int(stats["n_done"]) == 3
+        np.testing.assert_allclose(float(stats["avg_jct"]),
+                                   (100 + 50 + 30) / 3, rtol=1e-5)
+
+    def test_oversized_job_rejected_at_validation(self):
+        p = make_params(gpus=4)
+        big = to_array_trace([JobRecord(0, 0.0, 10.0, 8)], max_jobs=4)
+        with pytest.raises(ValueError):
+            hier.validate_hier_trace(p, big)
+
+
+TINY_HIER = dataclasses.replace(
+    HIER_PBT_MEMBER, n_nodes=4, gpus_per_node=4, n_pods=2, n_envs=4,
+    window_jobs=16, queue_len=4, horizon=64,
+    ppo=PPOConfig(n_steps=8, n_epochs=1, n_minibatches=2))
+
+
+class TestHierTraining:
+    def test_experiment_end_to_end(self):
+        exp = Experiment.build(TINY_HIER)
+        out = exp.run(iterations=2, log_every=1)
+        assert out["env_steps"] == 2 * 8 * 4
+        for h in out["history"]:
+            assert np.isfinite(h["total_loss"])
+            assert np.isfinite(h["mean_reward"])
+
+    def test_population_pbt_over_hier_members(self):
+        """Config 5 complete: PBT population of hierarchical 2-pod agents
+        on the (pop, data) mesh."""
+        mesh = make_mesh(n_pop=2)
+        exp = PopulationExperiment.build(
+            TINY_HIER, n_pop=2, mesh=mesh,
+            pbt_cfg=PBTConfig(ready_iters=2, seed=0))
+        out = exp.run(iterations=4)
+        assert out["pbt_events"] >= 1
+        assert all(np.isfinite(out["final_fitness"]))
