@@ -124,9 +124,12 @@ class Checkpointer:
                 state=ocp.args.StandardRestore(abstract),
                 meta=ocp.args.JsonRestore()))
         tree = restored["state"]
-        state = template_state.replace(
-            step=tree["step"], params=tree["params"],
-            opt_state=tree["opt_state"])
+        # TrainState is a flax struct (.replace); population MemberState is
+        # a NamedTuple (._replace) — both checkpoint through the same path
+        rep = getattr(template_state, "replace", None) or \
+            template_state._replace
+        state = rep(step=tree["step"], params=tree["params"],
+                    opt_state=tree["opt_state"])
         return state, tree.get("key"), tree.get("extra"), dict(
             restored["meta"] or {})
 

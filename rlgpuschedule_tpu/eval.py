@@ -18,8 +18,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .algos import action_dist
 from .env import env as env_lib
+from .env import hier as hier_lib
 from .env.env import EnvParams
+from .env.hier import HierParams
 from .sim import core
 from .sim.schedulers import run_baseline
 from .traces.records import ArrayTrace
@@ -35,20 +38,49 @@ class EvalResult(NamedTuple):
     steps: jax.Array        # i32[E] decision steps taken
 
 
-def _greedy_actions(logits: jax.Array) -> jax.Array:
-    return jnp.argmax(logits, axis=-1)
+def _greedy_actions(logits: Any) -> Any:
+    return jax.tree.map(lambda lg: jnp.argmax(lg, axis=-1), logits)
 
 
-def _random_actions(key: jax.Array, mask: jax.Array) -> jax.Array:
-    logits = jnp.where(mask, 0.0, -1e9)
-    return jax.random.categorical(key, logits)
+def _random_actions(key: jax.Array, mask: Any) -> Any:
+    logits = jax.tree.map(lambda m: jnp.where(m, 0.0, -1e9), mask)
+    actions, _ = action_dist.sample(key, logits)
+    return actions
 
 
-def replay(apply_fn: Callable, net_params: Any, env_params: EnvParams,
+class _EnvOps(NamedTuple):
+    """The env-specific slice of the replay loop (flat vs hierarchical)."""
+    step: Callable          # (state, trace, action) -> (state', ts)
+    capacity: int
+    busy: Callable          # batched state -> f32[E] allocated GPUs
+    jct_stats: Callable     # (state, trace) -> {avg_jct, n_done, ...}
+    makespan: Callable      # batched state -> f32[E]
+
+
+def _env_ops(params) -> _EnvOps:
+    if isinstance(params, HierParams):
+        return _EnvOps(
+            step=lambda s, tr, a: hier_lib.step(params, s, tr, a),
+            capacity=params.n_pods * params.pod_capacity,
+            busy=lambda s: jnp.sum(s.pods.alloc, axis=(1, 2, 3)
+                                   ).astype(jnp.float32),
+            jct_stats=hier_lib.jct_stats,
+            makespan=lambda s: s.pods.clock[:, 0])
+    return _EnvOps(
+        step=lambda s, tr, a: env_lib.step(params, s, tr, a),
+        capacity=params.sim.capacity,
+        busy=lambda s: jnp.sum(s.sim.alloc, axis=(1, 2)).astype(jnp.float32),
+        jct_stats=lambda s, tr: core.jct_stats(s.sim, tr),
+        makespan=lambda s: s.sim.clock)
+
+
+def replay(apply_fn: Callable, net_params: Any,
+           env_params: "EnvParams | HierParams",
            traces: core.Trace, max_steps: int | None = None,
            policy: str = "greedy", key: jax.Array | None = None,
            ) -> EvalResult:
-    """Deterministically replay the batched trace windows under the policy.
+    """Deterministically replay the batched trace windows under the policy
+    (flat configs 1-4 and the hierarchical config 5 share this harness).
 
     Unlike training rollouts there is NO auto-reset: each env runs its
     window to completion (or ``max_steps``) and is then frozen — the scan
@@ -67,9 +99,8 @@ def replay(apply_fn: Callable, net_params: Any, env_params: EnvParams,
         key = jax.random.PRNGKey(0)
     state, ts = env_lib.vec_reset(env_params, traces)
 
-    step_one = jax.vmap(lambda s, tr, a: env_lib.step(env_params, s, tr, a))
-    # time-integrated busy GPUs for time-averaged utilization
-    n_gpus = env_params.sim.capacity
+    ops = _env_ops(env_params)
+    step_one = jax.vmap(ops.step)
 
     def scan_step(carry, k):
         state, obs, mask, done, busy_time = carry
@@ -80,14 +111,14 @@ def replay(apply_fn: Callable, net_params: Any, env_params: EnvParams,
             actions = _greedy_actions(logits)
         new_state, new_ts = step_one(state, traces, actions)
         dt = jnp.where(done, 0.0, new_ts.info.dt)
-        busy = jnp.sum(state.sim.alloc, axis=(1, 2)).astype(jnp.float32)
-        busy_time = busy_time + busy * dt
+        busy_time = busy_time + ops.busy(state) * dt
         # freeze finished envs: keep the old state/obs/mask once done
         keep = lambda old, new: jnp.where(
             done.reshape((-1,) + (1,) * (new.ndim - 1)), old, new)
-        state = jax.tree.map(lambda o, n: keep(o, n), state, new_state)
-        obs = keep(obs, new_ts.obs)
-        mask = keep(mask, new_ts.action_mask)
+        tkeep = lambda old, new: jax.tree.map(keep, old, new)
+        state = tkeep(state, new_state)
+        obs = tkeep(obs, new_ts.obs)
+        mask = tkeep(mask, new_ts.action_mask)
         done = done | new_ts.done
         return (state, obs, mask, done, busy_time), None
 
@@ -96,9 +127,9 @@ def replay(apply_fn: Callable, net_params: Any, env_params: EnvParams,
             jnp.zeros(ts.done.shape, bool), jnp.zeros(ts.done.shape, jnp.float32))
     (state, _, _, done, busy_time), _ = jax.lax.scan(scan_step, init, keys)
 
-    stats = jax.vmap(lambda s, tr: core.jct_stats(s, tr))(state.sim, traces)
-    makespan = state.sim.clock
-    util = busy_time / (jnp.maximum(makespan, 1e-6) * n_gpus)
+    stats = jax.vmap(ops.jct_stats)(state, traces)
+    makespan = ops.makespan(state)
+    util = busy_time / (jnp.maximum(makespan, 1e-6) * ops.capacity)
     return EvalResult(avg_jct=stats["avg_jct"],
                       n_done=stats["n_done"].astype(jnp.int32),
                       n_valid=jnp.sum(traces.valid, axis=1).astype(jnp.int32),
@@ -146,13 +177,21 @@ def jct_report(exp, windows: list[ArrayTrace] | None = None,
     Returns {"policy": jct, "random": jct, <baseline>: jct, ...,
     "policy_completion": frac, "vs_tiresias": ratio} — ratio < 1.0 means the
     policy beats Tiresias (north-star #2, SURVEY.md §6).
+
+    For hierarchical experiments (config 5) the policy schedules gangs
+    within pods while the oracle baselines use the whole flat cluster —
+    the baselines get strictly more placement freedom, so the comparison
+    is conservative for the policy.
     """
     if windows is None:
         # the windows the experiment trained on (already validated/clamped
         # at build) — no re-ingest of the source trace
         windows, traces = exp.windows, exp.traces
     else:
-        traces = env_lib.stack_traces(windows, exp.env_params)
+        params = (exp.env_params.pod_sim
+                  if isinstance(exp.env_params, HierParams)
+                  else exp.env_params)
+        traces = env_lib.stack_traces(windows, params)
 
     report: dict[str, Any] = {}
     res = replay(exp.apply_fn, exp.train_state.params, exp.env_params,

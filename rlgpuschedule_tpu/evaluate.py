@@ -1,0 +1,93 @@
+"""Evaluation CLI (L6): ``python -m rlgpuschedule_tpu.evaluate``.
+
+Capability parity: SURVEY.md §3.4 — "run trained policy (or baseline) over
+full trace, report JCT table" (the eval/replay script of §2 "Eval / trace
+replay"). Loads a config (+ optional checkpoint), replays the trace windows
+under the greedy policy and the oracle baselines, and prints the avg-JCT
+comparison table — north-star metric #2's harness.
+
+Examples::
+
+    python -m rlgpuschedule_tpu.evaluate --config ppo-mlp-synth64
+    python -m rlgpuschedule_tpu.evaluate --config ppo-cnn-philly512 \
+        --trace-path philly.csv --ckpt-dir out/ckpt
+    python -m rlgpuschedule_tpu.evaluate --config ppo-mlp-synth64 \
+        --baselines-only
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="rlgpuschedule_tpu.evaluate",
+        description="JCT evaluation: trained policy vs baseline schedulers.")
+    p.add_argument("--config", default="ppo-mlp-synth64")
+    p.add_argument("--trace-path", default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--n-envs", type=int, default=None)
+    # cluster-shape overrides — MUST match the training run when restoring
+    # a checkpoint (shapes are part of the saved state)
+    p.add_argument("--n-nodes", type=int, default=None)
+    p.add_argument("--gpus-per-node", type=int, default=None)
+    p.add_argument("--window-jobs", type=int, default=None)
+    p.add_argument("--horizon", type=int, default=None)
+    p.add_argument("--ckpt-dir", default=None,
+                   help="restore the trained policy from this checkpoint "
+                        "dir (omit = untrained init weights)")
+    p.add_argument("--ckpt-step", type=int, default=None)
+    p.add_argument("--max-steps", type=int, default=None)
+    p.add_argument("--baselines-only", action="store_true")
+    p.add_argument("--no-random", action="store_true",
+                   help="skip the random-policy column")
+    return p
+
+
+def main(argv: list[str] | None = None) -> dict:
+    args = build_parser().parse_args(argv)
+    from .configs import CONFIGS
+    if args.config not in CONFIGS:
+        sys.exit(f"unknown config {args.config!r}")
+    cfg = CONFIGS[args.config]
+    over = {k: v for k, v in
+            {"trace_path": args.trace_path, "seed": args.seed,
+             "n_envs": args.n_envs, "n_nodes": args.n_nodes,
+             "gpus_per_node": args.gpus_per_node,
+             "window_jobs": args.window_jobs,
+             "horizon": args.horizon}.items() if v is not None}
+    cfg = dataclasses.replace(cfg, **over)
+
+    from .eval import baseline_jct_table, format_report, jct_report
+    from .experiment import Experiment, build_stack
+
+    if args.baselines_only:
+        _, windows, _, _, _, _ = build_stack(cfg)
+        report = baseline_jct_table(windows, cfg.n_nodes, cfg.gpus_per_node)
+        print(format_report(report), file=sys.stderr)
+        print(json.dumps(report))
+        return report
+
+    exp = Experiment.build(cfg)
+    if args.ckpt_dir:
+        from .checkpoint import Checkpointer
+        import os
+        with Checkpointer(os.path.abspath(args.ckpt_dir)) as ckpt:
+            exp.restore_checkpoint(ckpt, step=args.ckpt_step)
+        print(f"policy restored from {args.ckpt_dir}", file=sys.stderr)
+    else:
+        print("note: no --ckpt-dir; evaluating untrained init weights",
+              file=sys.stderr)
+    report = jct_report(exp, max_steps=args.max_steps,
+                        include_random=not args.no_random)
+    print(format_report(report), file=sys.stderr)
+    print(json.dumps({k: v for k, v in report.items()
+                      if isinstance(v, (int, float))}))
+    return report
+
+
+if __name__ == "__main__":
+    main()

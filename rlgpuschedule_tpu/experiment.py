@@ -64,6 +64,11 @@ def build_stack(cfg: ExperimentConfig):
         if cfg.n_nodes % cfg.n_pods != 0:
             raise ValueError(f"n_nodes={cfg.n_nodes} not divisible by "
                              f"n_pods={cfg.n_pods}")
+        if cfg.obs_kind != "flat" or cfg.reward_kind != "jct":
+            raise ValueError(
+                f"hierarchical configs use flat pod observations and the "
+                f"JCT reward; got obs_kind={cfg.obs_kind!r}, "
+                f"reward_kind={cfg.reward_kind!r}")
         pod_sim = SimParams(n_nodes=cfg.n_nodes // cfg.n_pods,
                             gpus_per_node=cfg.gpus_per_node,
                             max_jobs=cfg.window_jobs,
@@ -192,8 +197,10 @@ class Experiment:
         return meta
 
     def run(self, iterations: int | None = None, log_every: int = 0,
-            logger: Callable[[int, dict], None] | None = None) -> dict:
-        """Run the host training loop; returns summary metrics."""
+            logger: Callable[[int, dict], None] | None = None,
+            ckpt=None, ckpt_every: int = 0) -> dict:
+        """Run the host training loop; returns summary metrics. Pass a
+        ``checkpoint.Checkpointer`` + cadence to persist while training."""
         iterations = iterations or self.cfg.iterations
         history = []
         t0 = time.time()
@@ -206,6 +213,9 @@ class Experiment:
                 history.append({"iteration": i, **m})
                 if logger is not None:
                     logger(i, m)
+            if ckpt is not None and ckpt_every and \
+                    ((i + 1) % ckpt_every == 0 or i == iterations - 1):
+                self.save_checkpoint(ckpt, meta={"iteration": i})
         jax.block_until_ready(self.train_state.params)
         wall = time.time() - t0
         total_env_steps = iterations * self.steps_per_iteration
@@ -295,8 +305,35 @@ class PopulationExperiment:
     def steps_per_iteration(self) -> int:
         return self.cfg.ppo.n_steps * self.cfg.n_envs * self.n_pop
 
+    def save_checkpoint(self, ckpt, step: int | None = None,
+                        meta: dict | None = None, force: bool = False) -> bool:
+        """Persist the whole population (member stack + carries + hparams +
+        rollout keys) in one checkpoint."""
+        import numpy as np
+        extra = {"carries": self.carries, "keys": self.keys,
+                 "hparams": self.hparams}
+        step = (int(np.max(np.asarray(self.states.step)))
+                if step is None else step)
+        meta = dict(meta or {}, pbt_events=len(self.controller.history))
+        return ckpt.save(step, self.states, extra=extra, meta=meta,
+                         force=force)
+
+    def restore_checkpoint(self, ckpt, step: int | None = None) -> dict:
+        extra_t = {"carries": self.carries, "keys": self.keys,
+                   "hparams": self.hparams}
+        self.states, _key, extra, meta = ckpt.restore(
+            self.states, None, extra_t, step)
+        if extra is not None:
+            # structures restore into the template's treedefs, so these are
+            # already RolloutCarry / HParams
+            self.carries = extra["carries"]
+            self.keys = extra["keys"]
+            self.hparams = extra["hparams"]
+        return meta
+
     def run(self, iterations: int | None = None, log_every: int = 0,
-            logger: Callable[[int, dict], None] | None = None) -> dict:
+            logger: Callable[[int, dict], None] | None = None,
+            ckpt=None, ckpt_every: int = 0) -> dict:
         """Train the population; PBT exploit/explore fires every
         ``controller.cfg.ready_iters`` iterations. Returns summary metrics
         including per-member final fitness and the PBT event log."""
@@ -320,6 +357,9 @@ class PopulationExperiment:
                 history.append({"iteration": i, **m})
                 if logger is not None:
                     logger(i, m)
+            if ckpt is not None and ckpt_every and \
+                    ((i + 1) % ckpt_every == 0 or i == iterations - 1):
+                self.save_checkpoint(ckpt, meta={"iteration": i})
         jax.block_until_ready(self.states.params)
         wall = time.time() - t0
         total_env_steps = iterations * self.steps_per_iteration

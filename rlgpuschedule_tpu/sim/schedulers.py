@@ -131,10 +131,31 @@ def run_scheduler(sim: OracleSim, policy: SchedulerPolicy,
     raise RuntimeError("max_events exceeded")
 
 
-def run_baseline(trace, n_nodes: int, gpus_per_node: int,
-                 name: str) -> OracleSim:
+def run_baseline(trace, n_nodes: int, gpus_per_node: int, name: str,
+                 backend: str = "auto"):
     """Run one named baseline over a trace; returns the finished sim (the
-    single implementation behind every baseline JCT table)."""
+    single implementation behind every baseline JCT table).
+
+    ``backend``: "auto" uses the C++ engine (``rlgpuschedule_tpu.native``,
+    ~100× the Python oracle on production-scale traces) when a toolchain is
+    present, falling back to the oracle; "python" / "native" force one.
+    Both backends implement identical semantics (cross-validated in
+    tests/test_native.py); the returned object exposes at least
+    ``finish`` / ``jcts()`` / ``avg_jct()`` / ``trace``."""
+    if backend not in ("auto", "python", "native"):
+        raise ValueError(f"unknown backend {backend!r}")
+    if backend != "python":
+        from .. import native
+        if native.available():
+            from ..traces.records import ArrayTrace, to_array_trace
+            tr = trace if isinstance(trace, ArrayTrace) else \
+                to_array_trace(trace)
+            finish = native.run_baseline_native(tr, n_nodes, gpus_per_node,
+                                                name)
+            return native.NativeSimResult(tr, finish)
+        if backend == "native":
+            raise RuntimeError(
+                f"native backend unavailable: {native.build_error()}")
     sim = OracleSim(trace, n_nodes, gpus_per_node)
     return run_scheduler(sim, BASELINES[name]())
 

@@ -1,0 +1,156 @@
+"""Training CLI (L6): ``python -m rlgpuschedule_tpu.train --config <name>``.
+
+Capability parity: SURVEY.md §2 "Config/flags" and §3.1 "cli main (parse
+flags, seed, build trace)" — entry script selecting trace, cluster size,
+algorithm, encoder, env count, seeds; checkpointing; metric logging. The
+five driver capability configs are the named presets (``--list-configs``);
+every preset axis can be overridden from the command line.
+
+Examples::
+
+    python -m rlgpuschedule_tpu.train --config ppo-mlp-synth64
+    python -m rlgpuschedule_tpu.train --config ppo-cnn-philly512 \
+        --trace-path philly.csv --iterations 200 --ckpt-dir out/ckpt \
+        --log-csv out/metrics.csv --log-every 10 --report
+    python -m rlgpuschedule_tpu.train --config hier-pbt-member \
+        --pbt --n-pop 4 --pbt-ready 10            # config 5: PBT population
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from .configs import CONFIGS, ExperimentConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="rlgpuschedule_tpu.train",
+        description="Train an RL GPU-cluster scheduling policy (TPU-native).")
+    p.add_argument("--config", default="ppo-mlp-synth64",
+                   help="named preset (see --list-configs)")
+    p.add_argument("--list-configs", action="store_true")
+    # config overrides (None = keep preset value)
+    p.add_argument("--iterations", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--n-envs", type=int, default=None)
+    p.add_argument("--n-nodes", type=int, default=None)
+    p.add_argument("--gpus-per-node", type=int, default=None)
+    p.add_argument("--window-jobs", type=int, default=None)
+    p.add_argument("--horizon", type=int, default=None)
+    p.add_argument("--trace-path", default=None,
+                   help="CSV path for philly/pai traces")
+    # population / PBT (config 5)
+    p.add_argument("--pbt", action="store_true",
+                   help="train a PBT population instead of a single run")
+    p.add_argument("--n-pop", type=int, default=4)
+    p.add_argument("--pbt-ready", type=int, default=10,
+                   help="iterations between exploit/explore rounds")
+    # logging / checkpointing / profiling
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--log-csv", default=None)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--ckpt-every", type=int, default=50)
+    p.add_argument("--resume", action="store_true",
+                   help="restore the latest checkpoint from --ckpt-dir")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the run")
+    p.add_argument("--report", action="store_true",
+                   help="print the JCT-vs-baselines table after training "
+                        "(single-run, non-hierarchical configs)")
+    return p
+
+
+def apply_overrides(cfg: ExperimentConfig,
+                    args: argparse.Namespace) -> ExperimentConfig:
+    fields = {"iterations": args.iterations, "seed": args.seed,
+              "n_envs": args.n_envs, "n_nodes": args.n_nodes,
+              "gpus_per_node": args.gpus_per_node,
+              "window_jobs": args.window_jobs, "horizon": args.horizon,
+              "trace_path": args.trace_path}
+    return dataclasses.replace(
+        cfg, **{k: v for k, v in fields.items() if v is not None})
+
+
+def make_pop_mesh(n_pop: int):
+    """Best (pop, data) mesh for the available devices: the largest pop
+    axis that divides both the population and the device count (1 device →
+    no mesh)."""
+    import jax
+    from .parallel import make_mesh
+    n_dev = jax.device_count()
+    if n_dev == 1:
+        return None
+    pop_axis = 1
+    for c in range(min(n_pop, n_dev), 0, -1):
+        if n_pop % c == 0 and n_dev % c == 0:
+            pop_axis = c
+            break
+    return make_mesh(devices=None, n_pop=pop_axis)
+
+
+def main(argv: list[str] | None = None) -> dict:
+    args = build_parser().parse_args(argv)
+    if args.list_configs:
+        for name, c in CONFIGS.items():
+            print(f"{name:20s} algo={c.algo} obs={c.obs_kind} "
+                  f"cluster={c.n_nodes}x{c.gpus_per_node} trace={c.trace}"
+                  f"{' pods=' + str(c.n_pods) if c.n_pods > 1 else ''}")
+        return {}
+    if args.config not in CONFIGS:
+        sys.exit(f"unknown config {args.config!r}; try --list-configs")
+    cfg = apply_overrides(CONFIGS[args.config], args)
+
+    import contextlib
+
+    from .utils import MetricsLogger, profiling
+
+    ckpt = None
+    if args.ckpt_dir:
+        from .checkpoint import Checkpointer
+        import os
+        ckpt = Checkpointer(os.path.abspath(args.ckpt_dir))
+
+    with contextlib.ExitStack() as stack:
+        logger = stack.enter_context(
+            MetricsLogger(args.log_csv, echo=args.log_every > 0))
+        if args.profile_dir:
+            stack.enter_context(profiling.trace(args.profile_dir))
+        if ckpt is not None:
+            stack.enter_context(ckpt)
+
+        if args.pbt:
+            from .experiment import PopulationExperiment
+            from .parallel import PBTConfig
+            exp = PopulationExperiment.build(
+                cfg, n_pop=args.n_pop, mesh=make_pop_mesh(args.n_pop),
+                pbt_cfg=PBTConfig(ready_iters=args.pbt_ready,
+                                  seed=cfg.seed))
+        else:
+            from .experiment import Experiment
+            exp = Experiment.build(cfg)
+        if args.resume:
+            if ckpt is None:
+                sys.exit("--resume requires --ckpt-dir")
+            meta = exp.restore_checkpoint(ckpt)
+            print(f"resumed from step {ckpt.latest_step()} ({meta})",
+                  file=sys.stderr)
+
+        out = exp.run(log_every=args.log_every, logger=logger,
+                      ckpt=ckpt, ckpt_every=args.ckpt_every)
+
+        summary = {k: v for k, v in out.items() if k != "history"}
+        if args.report and not args.pbt and cfg.n_pods == 1:
+            from .eval import format_report, jct_report
+            report = jct_report(exp)
+            print(format_report(report), file=sys.stderr)
+            summary["jct_report"] = {k: v for k, v in report.items()
+                                     if isinstance(v, (int, float))}
+        print(json.dumps(summary))
+        return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Metrics logging (L6 aux): scalar curves to CSV + console.
+
+Capability parity: SURVEY.md §2 "Metrics/logging" and §5 "Metrics /
+logging / observability" — reward curves, env-steps/sec, avg/percentile
+JCT, cluster utilization. The reference's TensorBoard-style scalar stream
+becomes an append-only CSV (one row per logged iteration, stable header)
+that pandas/TensorBoard ingest trivially; the JCT comparison table is
+produced by ``eval.jct_report``/``format_report``.
+"""
+from __future__ import annotations
+
+import csv
+import os
+import sys
+import time
+from typing import IO, Any, Mapping
+
+
+class MetricsLogger:
+    """Append scalar rows keyed by iteration; writes CSV and optionally
+    mirrors a compact line to a stream.
+
+    >>> log = MetricsLogger("out/metrics.csv", echo=True)
+    >>> log(10, {"mean_reward": -0.5, "total_loss": 0.1})
+    >>> log.close()
+
+    The header is fixed by the first row (stable schema for the whole
+    run); any later row whose keys differ from the first row's raises, so
+    schema drift is caught at the call site rather than producing ragged
+    CSVs.
+    """
+
+    def __init__(self, csv_path: str | None = None, echo: bool = False,
+                 stream: IO[str] | None = None):
+        self._csv_path = csv_path
+        self._echo = echo
+        self._stream = stream or sys.stderr
+        self._writer: csv.DictWriter | None = None
+        self._file: IO[str] | None = None
+        self._fields: list[str] | None = None
+        self._t0 = time.time()
+
+    def __call__(self, iteration: int, metrics: Mapping[str, Any]) -> None:
+        row = {"iteration": iteration,
+               "wall_s": round(time.time() - self._t0, 3)}
+        for k, v in metrics.items():
+            row[k] = float(v) if hasattr(v, "__float__") else v
+        if self._csv_path is not None:
+            if self._writer is None:
+                os.makedirs(os.path.dirname(self._csv_path) or ".",
+                            exist_ok=True)
+                self._file = open(self._csv_path, "w", newline="")
+                self._fields = list(row)
+                self._writer = csv.DictWriter(self._file, self._fields)
+                self._writer.writeheader()
+            elif set(row) != set(self._fields):
+                raise ValueError(
+                    f"metrics schema drift: first row had "
+                    f"{sorted(self._fields)}, this row has {sorted(row)}")
+            self._writer.writerow(row)
+            self._file.flush()
+        if self._echo:
+            body = " ".join(f"{k}={v:.4g}" if isinstance(v, float)
+                            else f"{k}={v}" for k, v in row.items()
+                            if k != "iteration")
+            print(f"[iter {iteration}] {body}", file=self._stream)
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class ThroughputMeter:
+    """env-steps/sec tracker for the north-star throughput metric
+    (SURVEY.md §6 metric #1). Call ``tick(n_steps)`` once per iteration."""
+
+    def __init__(self):
+        self._t0 = time.time()
+        self._steps = 0
+
+    def tick(self, n_steps: int) -> None:
+        self._steps += int(n_steps)
+
+    @property
+    def steps_per_sec(self) -> float:
+        dt = time.time() - self._t0
+        return self._steps / dt if dt > 0 else 0.0

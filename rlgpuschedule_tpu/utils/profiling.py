@@ -1,0 +1,69 @@
+"""Tracing / profiling (L6 aux): XLA/TPU profiler integration.
+
+Capability parity: SURVEY.md §5 "Tracing / profiling" — the reference's
+ad-hoc timers become first-class ``jax.profiler`` traces (viewable in
+Perfetto / TensorBoard-profile) plus a lightweight host-side section
+timer for the driver loop. Debug invariant checking (SURVEY.md §5 "Race
+detection / sanitizers": JAX's purity removes data races by construction;
+NaN debugging is a flag flip) is exposed via :func:`debug_checks`.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a device trace for the enclosed block::
+
+        with profiling.trace("/tmp/jax-trace"):
+            exp.run(iterations=5)
+
+    Open the resulting directory with TensorBoard's profile plugin or
+    Perfetto. On TPU this records per-op device timelines (MXU/HBM
+    utilization); on CPU it still records XLA host ops."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def debug_checks(nans: bool = True) -> Iterator[None]:
+    """Enable jax_debug_nans for the enclosed block (CI hook — SURVEY.md §4
+    determinism/regression + §5 sanitizers)."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", nans)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+class SectionTimer:
+    """Cumulative host-side wall-clock per named section.
+
+    >>> t = SectionTimer()
+    >>> with t("rollout"): ...
+    >>> t.report()  # {'rollout': 1.23}
+    """
+
+    def __init__(self):
+        self._acc: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def __call__(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._acc[name] = (self._acc.get(name, 0.0)
+                               + time.perf_counter() - t0)
+
+    def report(self) -> dict[str, float]:
+        return dict(self._acc)

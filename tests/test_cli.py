@@ -1,0 +1,113 @@
+"""CLI + utils tests (SURVEY.md §2 "Config/flags" / "Metrics/logging",
+§3.1 cli main, §5 tracing)."""
+import csv
+import json
+import os
+
+import numpy as np
+import pytest
+
+from rlgpuschedule_tpu import evaluate as evaluate_cli
+from rlgpuschedule_tpu import train as train_cli
+from rlgpuschedule_tpu.utils import (MetricsLogger, SectionTimer,
+                                     ThroughputMeter)
+
+FAST = ["--iterations", "2", "--n-envs", "4", "--n-nodes", "2",
+        "--gpus-per-node", "4", "--window-jobs", "16", "--log-every", "1"]
+
+
+class TestMetricsLogger:
+    def test_csv_rows_and_echo(self, tmp_path, capsys):
+        path = str(tmp_path / "m.csv")
+        with MetricsLogger(path, echo=False) as log:
+            log(0, {"loss": 1.5, "reward": -2.0})
+            log(10, {"loss": 1.0, "reward": -1.0})
+        rows = list(csv.DictReader(open(path)))
+        assert len(rows) == 2
+        assert float(rows[1]["loss"]) == 1.0
+        assert rows[1]["iteration"] == "10"
+
+    def test_throughput_meter(self):
+        m = ThroughputMeter()
+        m.tick(100)
+        m.tick(100)
+        assert m.steps_per_sec > 0
+
+    def test_section_timer(self):
+        t = SectionTimer()
+        with t("a"):
+            pass
+        with t("a"):
+            pass
+        assert "a" in t.report() and t.report()["a"] >= 0
+
+
+class TestTrainCLI:
+    def test_list_configs(self, capsys):
+        train_cli.main(["--list-configs"])
+        out = capsys.readouterr().out
+        for name in ("ppo-mlp-synth64", "ppo-cnn-philly512", "a2c-pai-fair",
+                     "gnn-gang-place", "hier-pbt-member"):
+            assert name in out
+
+    def test_unknown_config_exits(self):
+        with pytest.raises(SystemExit):
+            train_cli.main(["--config", "nope"])
+
+    def test_train_logs_and_checkpoints(self, tmp_path, capsys):
+        csv_path = str(tmp_path / "metrics.csv")
+        ckpt_dir = str(tmp_path / "ckpt")
+        summary = train_cli.main(
+            ["--config", "ppo-mlp-synth64", *FAST,
+             "--log-csv", csv_path, "--ckpt-dir", ckpt_dir,
+             "--ckpt-every", "1"])
+        assert summary["iterations"] == 2
+        assert np.isfinite(summary["env_steps_per_sec"])
+        rows = list(csv.DictReader(open(csv_path)))
+        assert len(rows) == 2
+        assert os.path.isdir(ckpt_dir) and os.listdir(ckpt_dir)
+        # stdout's last line is the summary JSON
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        assert json.loads(line)["iterations"] == 2
+
+    def test_resume_roundtrip(self, tmp_path):
+        ckpt_dir = str(tmp_path / "ckpt")
+        args = ["--config", "ppo-mlp-synth64", *FAST,
+                "--ckpt-dir", ckpt_dir, "--ckpt-every", "2"]
+        train_cli.main(args)
+        out = train_cli.main(args + ["--resume"])
+        assert out["iterations"] == 2
+
+    def test_pbt_training(self, tmp_path):
+        summary = train_cli.main(
+            ["--config", "hier-pbt-member", "--pbt", "--n-pop", "2",
+             "--pbt-ready", "1", "--iterations", "2", "--n-envs", "4",
+             "--n-nodes", "4", "--gpus-per-node", "4",
+             "--window-jobs", "16", "--log-every", "1"])
+        assert summary["pbt_events"] >= 1
+        assert all(np.isfinite(summary["final_fitness"]))
+
+    def test_report_flag(self, capsys):
+        summary = train_cli.main(
+            ["--config", "ppo-mlp-synth64", *FAST, "--report"])
+        assert "tiresias" in summary["jct_report"]
+
+
+class TestEvaluateCLI:
+    def test_baselines_only(self, capsys):
+        report = evaluate_cli.main(
+            ["--config", "ppo-mlp-synth64", "--baselines-only"])
+        assert set(report) >= {"fifo", "sjf", "srtf", "tiresias"}
+
+    def test_policy_eval_untrained(self):
+        report = evaluate_cli.main(
+            ["--config", "ppo-mlp-synth64", "--n-envs", "4", "--no-random",
+             "--max-steps", "64"])
+        assert "policy" in report and "vs_tiresias" in report
+
+    def test_hier_policy_eval(self):
+        report = evaluate_cli.main(
+            ["--config", "hier-pbt-member", "--n-envs", "2", "--no-random",
+             "--max-steps", "48"])
+        assert "policy" in report and "tiresias" in report
+        assert np.isfinite(report["policy"])

@@ -1,0 +1,84 @@
+"""Native C++ baseline engine: cross-validation against the Python oracle
+(SURVEY.md §2 "Native components", §4 "Baseline-scheduler oracle tests" —
+the two backends must produce identical schedules)."""
+import time
+
+import numpy as np
+import pytest
+
+from rlgpuschedule_tpu import native
+from rlgpuschedule_tpu.sim.schedulers import run_baseline
+from rlgpuschedule_tpu.traces import gen_poisson_trace
+from rlgpuschedule_tpu.traces.records import JobRecord, to_array_trace
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native engine unavailable: {native.build_error()}")
+
+POLICIES = ("fifo", "sjf", "srtf", "tiresias")
+
+
+class TestCrossValidation:
+    @pytest.mark.parametrize("name", POLICIES)
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_python_oracle(self, name, seed):
+        """Bit-identical finish times vs the oracle on overloaded random
+        traces (rate·E[dur]·E[gpus] >> capacity forces deep queues,
+        preemption, and Tiresias demotions)."""
+        tr = gen_poisson_trace(0.05, 80, seed=seed, mean_duration=2000.0)
+        py = run_baseline(tr, 2, 8, name, backend="python")
+        nat = run_baseline(tr, 2, 8, name, backend="native")
+        np.testing.assert_allclose(
+            np.where(np.isnan(nat.finish), np.inf, nat.finish)[tr.valid],
+            np.where(np.isnan(py.finish), np.inf, py.finish)[tr.valid],
+            rtol=0, atol=1e-6)
+        assert nat.avg_jct() == pytest.approx(py.avg_jct(), rel=1e-9)
+
+    @pytest.mark.parametrize("name", POLICIES)
+    def test_underloaded_trace(self, name):
+        tr = gen_poisson_trace(0.001, 30, seed=3, mean_duration=100.0)
+        py = run_baseline(tr, 4, 8, name, backend="python")
+        nat = run_baseline(tr, 4, 8, name, backend="native")
+        assert nat.avg_jct() == pytest.approx(py.avg_jct(), rel=1e-9)
+
+    def test_hand_checked_fifo(self):
+        """2-GPU cluster, three 2-GPU jobs of 10s at t=0: FIFO serializes
+        them → finishes 10/20/30, JCTs 10/20/30."""
+        tr = to_array_trace([JobRecord(0, 0.0, 10.0, 2),
+                             JobRecord(1, 0.0, 10.0, 2),
+                             JobRecord(2, 0.0, 10.0, 2)])
+        nat = run_baseline(tr, 1, 2, "fifo", backend="native")
+        np.testing.assert_allclose(sorted(nat.jcts()), [10.0, 20.0, 30.0])
+
+    def test_srtf_preempts(self):
+        """Long job starts, short job arrives: SRTF preempts the long one;
+        short JCT = its duration."""
+        tr = to_array_trace([JobRecord(0, 0.0, 100.0, 2),
+                             JobRecord(1, 5.0, 10.0, 2)])
+        nat = run_baseline(tr, 1, 2, "srtf", backend="native")
+        py = run_baseline(tr, 1, 2, "srtf", backend="python")
+        np.testing.assert_allclose(sorted(nat.jcts()), sorted(py.jcts()))
+        assert min(nat.jcts()) == pytest.approx(10.0)
+
+
+class TestErrorsAndSpeed:
+    def test_oversized_gang_raises(self):
+        tr = to_array_trace([JobRecord(0, 0.0, 10.0, 64)])
+        with pytest.raises(RuntimeError):
+            native.run_baseline_native(tr, 1, 8, "fifo")
+
+    def test_unknown_policy(self):
+        tr = to_array_trace([JobRecord(0, 0.0, 10.0, 1)])
+        with pytest.raises(ValueError):
+            native.run_baseline_native(tr, 1, 8, "nope")
+
+    def test_large_trace_fast(self):
+        """Production-scale sanity: 20k jobs through a preemptive policy in
+        seconds, not minutes (the point of the native engine)."""
+        tr = gen_poisson_trace(0.5, 20_000, seed=7, mean_duration=1800.0)
+        t0 = time.time()
+        nat = run_baseline(tr, 64, 8, "tiresias", backend="native")
+        wall = time.time() - t0
+        assert np.isfinite(nat.avg_jct())
+        assert len(nat.jcts()) == tr.num_jobs
+        assert wall < 30.0, f"native tiresias took {wall:.1f}s on 20k jobs"
