@@ -28,9 +28,9 @@ import subprocess
 import sys
 import time
 
-# First recorded value on the target chip (TPU v5e). None until round 1
-# measures it; the driver's BENCH_r1.json becomes the reference point.
-BENCH_BASELINE_VALUE: float | None = None
+# First recorded value on the target chip (TPU v5 lite, round 1,
+# 2026-07-29): 67.93M env-steps/s/chip for the full fused PPO loop.
+BENCH_BASELINE_VALUE: float | None = 67_931_471.7
 BENCH_BASELINE_PLATFORM = "tpu"
 
 

@@ -38,6 +38,7 @@ class ExperimentConfig:
     horizon: int = 512
     time_scale: float = 600.0
     reward_scale: float = 10_000.0
+    place_bonus: float = 0.05   # shaping vs the idle local optimum (rewards.py)
     # training
     ppo: PPOConfig = PPOConfig()
     a2c: A2CConfig = A2CConfig()

@@ -36,6 +36,7 @@ class EnvParams:
     n_tenants: int = 1
     time_scale: float = 600.0     # normalizes times in observations
     reward_scale: float = 1000.0  # divides reward magnitudes
+    place_bonus: float = 0.0      # potential-based shaping (rewards.py)
     horizon: int = 512            # max decision steps per episode
 
     @property
@@ -93,7 +94,8 @@ def step(params: EnvParams, state: EnvState, trace: Trace,
         reward = reward_lib.reward_fair(sim_before, trace, info,
                                         params.n_tenants, params.reward_scale)
     else:
-        reward = reward_lib.reward_jct(info, params.reward_scale)
+        reward = reward_lib.reward_jct(info, params.reward_scale,
+                                       params.place_bonus)
     t = state.t + 1
     done = info.done | (t >= params.horizon)
     new_state = EnvState(sim=sim, t=t)

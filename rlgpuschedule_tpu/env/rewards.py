@@ -11,12 +11,24 @@ import jax.numpy as jnp
 from ..sim.core import SimState, Trace, StepInfo, PENDING, RUNNING
 
 
-def reward_jct(info: StepInfo, reward_scale: float) -> jax.Array:
+def reward_jct(info: StepInfo, reward_scale: float,
+               place_bonus: float = 0.0) -> jax.Array:
     """Exact JCT objective: Σ JCT = ∫ n_in_system(t) dt, so accumulating
     ``-dt · n_in_system`` over decision intervals makes the (undiscounted)
-    episode return equal −Σ JCT / scale. Scheduling actions cost dt = 0, so
-    only idling is penalized — no reward shaping needed."""
-    return -(info.dt * info.in_system_before.astype(jnp.float32)) / reward_scale
+    episode return equal −Σ JCT / scale.
+
+    ``place_bonus`` adds a small reward per successful placement. Without
+    preemption a job is placed at most once, so the bonus telescopes to a
+    per-episode constant for every policy that schedules all jobs — it is
+    potential-based shaping (φ = bonus · #placed) that gives the actor
+    immediate credit for admitting work instead of waiting for that credit
+    to propagate through the critic. Empirically this breaks the
+    idle-until-drained local optimum (policy no-ops ~50% of feasible steps
+    without it)."""
+    base = -(info.dt * info.in_system_before.astype(jnp.float32)) / reward_scale
+    if place_bonus:
+        return base + place_bonus * info.placed.astype(jnp.float32)
+    return base
 
 
 def tenant_counts(state: SimState, trace: Trace, n_tenants: int) -> jax.Array:

@@ -31,7 +31,7 @@ def build_env_params(cfg: ExperimentConfig) -> EnvParams:
     return EnvParams(sim=sim, obs_kind=cfg.obs_kind,
                      reward_kind=cfg.reward_kind, n_tenants=cfg.n_tenants,
                      time_scale=cfg.time_scale, reward_scale=cfg.reward_scale,
-                     horizon=cfg.horizon)
+                     place_bonus=cfg.place_bonus, horizon=cfg.horizon)
 
 
 def load_source_trace(cfg: ExperimentConfig, n_jobs: int | None = None,
