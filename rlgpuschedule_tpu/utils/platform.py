@@ -1,0 +1,70 @@
+"""CPU-platform pinning shared by the test rig and the driver dryrun.
+
+This machine's ``sitecustomize`` registers a real-TPU tunnel backend
+("axon") in every Python process and pins ``jax_platforms`` to it; when
+the tunnel is unhealthy, initializing that backend hangs forever. Both the
+test suite (tests/conftest.py) and ``__graft_entry__.dryrun_multichip``
+need the opposite: N virtual CPU devices, pinned before ANY jax backend
+initializes (SURVEY.md §4 "Distributed without a real cluster"). One
+helper so a jax upgrade that moves the private
+``backends_are_initialized`` probe breaks exactly one place.
+"""
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(n_devices: int = 8) -> list:
+    """Pin jax to the CPU platform with ``n_devices`` virtual devices and
+    return them.
+
+    jax may already be imported (sitecustomize imports it), but as long as
+    its backends are still lazy the pin works: flip ``jax_platforms`` to
+    cpu and set ``--xla_force_host_platform_device_count`` before first
+    device access. If backends already initialized as CPU this is a no-op
+    that returns the existing devices; if they initialized as anything
+    else, raises with an actionable message (the fix is a fresh process)
+    instead of the opaque backend errors that follow otherwise.
+
+    The env-var mutations are reverted before returning: in-process the
+    pin lives in the initialized backend, and leaking ``JAX_PLATFORMS=cpu``
+    into the environment would silently force later-spawned subprocesses
+    (e.g. a real-TPU bench) onto CPU.
+    """
+    prev = {k: os.environ.get(k) for k in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    try:
+        import jax
+
+        if not jax._src.xla_bridge.backends_are_initialized():
+            jax.config.update("jax_platforms", "cpu")
+        try:
+            devices = jax.devices("cpu")
+        except RuntimeError as e:
+            raise RuntimeError(
+                f"cannot obtain CPU devices: jax backends were already "
+                f"initialized (default backend "
+                f"{jax.default_backend()!r}) before force_cpu could pin "
+                f"the platform — run the CPU-mesh program in a fresh "
+                f"process") from e
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if not devices or any(d.platform != "cpu" for d in devices):
+        raise RuntimeError(f"force_cpu got non-CPU devices: {devices}")
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"force_cpu({n_devices}) got only {len(devices)} CPU devices — "
+            f"either the CPU backend initialized before this call, or "
+            f"XLA_FLAGS already pins a smaller "
+            f"xla_force_host_platform_device_count; a multichip program "
+            f"must not silently degrade to {len(devices)} device(s)")
+    return devices

@@ -6,25 +6,15 @@ This is the standard JAX substitute for a multi-chip test rig (SURVEY.md §4
 against 8 virtual CPU devices, so the data-parallel and PBT sync logic is
 exercised in CI with no TPU attached.
 
-Machine quirk: a sitecustomize on PYTHONPATH registers a real-TPU tunnel
-backend ("axon") in every Python process and pins ``jax_platforms`` to it;
-when the tunnel is unhealthy, initializing it hangs forever. jax is therefore
-already imported by the time this conftest runs, but its backends are still
-lazy — so we flip ``jax_platforms`` to cpu and set the virtual device count
-before any backend initializes.
+The pinning itself (including the machine's axon-sitecustomize quirk it
+defends against) lives in ``rlgpuschedule_tpu.utils.platform.force_cpu``,
+shared with ``__graft_entry__.dryrun_multichip``.
 """
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402  (sitecustomize imported it already; this is a no-op)
-
-jax.config.update("jax_platforms", "cpu")
-assert not jax._src.xla_bridge.backends_are_initialized(), (
-    "a plugin initialized JAX backends before conftest; CPU forcing failed")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rlgpuschedule_tpu.utils.platform import force_cpu  # noqa: E402
+
+force_cpu(8)  # raises (with the cause named) if 8 CPU devices can't be had
