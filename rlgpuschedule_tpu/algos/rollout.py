@@ -57,12 +57,16 @@ def rollout(apply_fn: PolicyApply, net_params, env_params: EnvParams,
             ) -> tuple[RolloutCarry, Transition, jax.Array]:
     """Collect ``n_steps`` transitions from the vectorized envs in one scan.
     Returns (carry', transitions [T,E,...], last_value [E])."""
+    # the auto-reset bundle depends only on the traces: build it once here
+    # (a scan constant) instead of re-running a full reset every step
+    fresh = env_lib.vec_reset(env_params, traces)
 
     def step(c: RolloutCarry, _):
         logits, value = apply_fn(net_params, c.obs, c.mask)
         key, sub = jax.random.split(c.key)
         action, log_prob = action_dist.sample(sub, logits)
-        env_state, ts = env_lib.vec_step(env_params, c.env_state, traces, action)
+        env_state, ts = env_lib.vec_step(env_params, c.env_state, traces,
+                                         action, fresh)
         t = Transition(obs=c.obs, action=action, log_prob=log_prob,
                        value=value, reward=ts.reward, done=ts.done,
                        mask=c.mask, env_steps_dt=ts.info.dt)

@@ -194,6 +194,12 @@ def _vmap_pods(fn, pods: SimState, *args):
     return jax.vmap(lambda ps, *a: fn(ps, *a))(pods, *args)
 
 
+def _pod_queues(params: HierParams, pods: SimState) -> jax.Array:
+    """Every pod's pending queue, [P, K]."""
+    return _vmap_pods(lambda ps: core.pending_queue(params.pod_sim, ps),
+                      pods)
+
+
 def next_event_time(state: HierState, trace: Trace) -> jax.Array:
     """Earliest future trace arrival or any-pod completion (+inf if none)."""
     clock = global_clock(state)
@@ -235,13 +241,16 @@ def forced_progress(params: HierParams, state: HierState, trace: Trace,
 
 # ---- observations / masks ---------------------------------------------------
 
-def build_obs(params: HierParams, state: HierState, trace: Trace) -> dict:
+def build_obs(params: HierParams, state: HierState, trace: Trace,
+              queues: jax.Array | None = None) -> dict:
     sp = params.pod_sim
     clock = global_clock(state)
     # per-pod flat observations (shared-weight pod agents), [P, D_pod]
+    if queues is None:
+        queues = _pod_queues(params, state.pods)
     pod_obs = _vmap_pods(
-        lambda ps: obs_lib.flat_obs(sp, ps, trace, params.time_scale),
-        state.pods)
+        lambda ps, q: obs_lib.flat_obs(sp, ps, trace, params.time_scale, q),
+        state.pods, queues)
     # router observation: per-pod summaries + head job + global load
     free_frac = jnp.sum(state.pods.free, axis=1) / sp.capacity       # [P]
     pending = jnp.sum(state.pods.status == PENDING, axis=1)          # [P]
@@ -266,14 +275,27 @@ def build_obs(params: HierParams, state: HierState, trace: Trace) -> dict:
     return {"top": top, "pods": pod_obs}
 
 
-def action_mask(params: HierParams, state: HierState, trace: Trace) -> dict:
+def action_mask(params: HierParams, state: HierState, trace: Trace,
+                queues: jax.Array | None = None) -> dict:
     j, exists = head_unassigned(state, trace)
     fits = trace.gpus[j] <= params.pod_capacity
     route_ok = jnp.broadcast_to(exists & fits, (params.n_pods,))
     top = jnp.concatenate([route_ok, jnp.ones((1,), bool)])
+    if queues is None:
+        queues = _pod_queues(params, state.pods)
     pod_masks = _vmap_pods(
-        lambda ps: core.action_mask(params.pod_sim, ps, trace), state.pods)
+        lambda ps, q: core.action_mask(params.pod_sim, ps, trace, q),
+        state.pods, queues)
     return {"top": top, "pods": pod_masks}
+
+
+def _observe(params: HierParams, state: HierState, trace: Trace,
+             ) -> tuple[dict, dict]:
+    """(obs, mask), computing each pod's pending queue once and sharing it
+    between the observation builder and the action mask."""
+    queues = _pod_queues(params, state.pods)
+    return (build_obs(params, state, trace, queues),
+            action_mask(params, state, trace, queues))
 
 
 # ---- reset / step -----------------------------------------------------------
@@ -288,9 +310,9 @@ def reset(params: HierParams, trace: Trace) -> tuple[HierState, TimeStep]:
     info = StepInfo(placed=jnp.bool_(False), dt=jnp.float32(0.0),
                     in_system_before=in_system(state, trace),
                     done=jnp.bool_(False))
-    ts = TimeStep(obs=build_obs(params, state, trace),
-                  reward=jnp.float32(0.0), done=jnp.bool_(False),
-                  action_mask=action_mask(params, state, trace), info=info)
+    obs, mask = _observe(params, state, trace)
+    ts = TimeStep(obs=obs, reward=jnp.float32(0.0), done=jnp.bool_(False),
+                  action_mask=mask, info=info)
     return state, ts
 
 
@@ -336,18 +358,20 @@ def step(params: HierParams, state: HierState, trace: Trace,
                     done=all_done(new_state, trace))
     reward = -(dt * n_before.astype(jnp.float32)) / params.reward_scale
     done = info.done | (new_state.t >= params.horizon)
-    ts = TimeStep(obs=build_obs(params, new_state, trace), reward=reward,
-                  done=done,
-                  action_mask=action_mask(params, new_state, trace),
+    obs, mask = _observe(params, new_state, trace)
+    ts = TimeStep(obs=obs, reward=reward, done=done, action_mask=mask,
                   info=info)
     return new_state, ts
 
 
 def auto_reset_step(params: HierParams, state: HierState, trace: Trace,
-                    action: dict) -> tuple[HierState, TimeStep]:
+                    action: dict, fresh=None) -> tuple[HierState, TimeStep]:
+    """Step + fused auto-reset; pass a precomputed ``fresh = reset(params,
+    trace)`` when stepping in a loop (see env.auto_reset_step)."""
     stepped, ts = step(params, state, trace, action)
-    fresh, fresh_ts = reset(params, trace)
-    return env_lib.auto_reset(stepped, ts, fresh, fresh_ts)
+    fresh_state, fresh_ts = (reset(params, trace) if fresh is None
+                             else fresh)
+    return env_lib.auto_reset(stepped, ts, fresh_state, fresh_ts)
 
 
 # ---- vectorization (rollout integration via singledispatch) -----------------
@@ -359,6 +383,9 @@ def _(params: HierParams, traces: Trace) -> tuple[HierState, TimeStep]:
 
 @env_lib.vec_step.register
 def _(params: HierParams, state: HierState, traces: Trace,
-      actions: dict) -> tuple[HierState, TimeStep]:
-    return jax.vmap(lambda s, tr, a: auto_reset_step(params, s, tr, a)
-                    )(state, traces, actions)
+      actions: dict, fresh=None) -> tuple[HierState, TimeStep]:
+    if fresh is None:
+        return jax.vmap(lambda s, tr, a: auto_reset_step(params, s, tr, a)
+                        )(state, traces, actions)
+    return jax.vmap(lambda s, tr, a, f: auto_reset_step(params, s, tr, a, f)
+                    )(state, traces, actions, fresh)

@@ -300,11 +300,14 @@ def attained_service(state: SimState, trace: Trace) -> jax.Array:
     return executed * trace.gpus.astype(jnp.float32)
 
 
-def action_mask(params: SimParams, state: SimState, trace: Trace) -> jax.Array:
+def action_mask(params: SimParams, state: SimState, trace: Trace,
+                queue: jax.Array | None = None) -> jax.Array:
     """bool[n_actions]: queue-slot actions valid iff the slot holds a pending
     job whose gang fits in the free GPUs (pack and spread share feasibility:
-    jobs may span nodes). No-op is always valid."""
-    queue = pending_queue(params, state)                       # [K]
+    jobs may span nodes). No-op is always valid. Pass a precomputed
+    ``pending_queue`` to share it with the observation builder."""
+    if queue is None:
+        queue = pending_queue(params, state)                   # [K]
     jc = jnp.clip(queue, 0, params.max_jobs - 1)
     demand = trace.gpus[jc]
     ok = (queue >= 0) & (demand <= jnp.sum(state.free))        # [K]

@@ -21,7 +21,7 @@ def test_entry_compiles_and_runs():
 def test_dryrun_multichip_8_devices():
     import __graft_entry__ as ge
 
-    assert jax.device_count() == 8
+    assert jax.device_count() >= 8
     ge.dryrun_multichip(8)
 
 
