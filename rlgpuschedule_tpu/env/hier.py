@@ -42,6 +42,7 @@ from ..sim.core import (DONE, INF, PACK, PENDING, RUNNING, SimParams,
 from ..traces.records import ArrayTrace
 from . import env as env_lib
 from . import obs as obs_lib
+from . import rewards as reward_lib
 from .env import TimeStep
 from ..sim.core import StepInfo
 
@@ -55,6 +56,7 @@ class HierParams:
     pod_sim: SimParams
     time_scale: float = 600.0
     reward_scale: float = 10_000.0
+    place_bonus: float = 0.0    # shaping per progress step (rewards.py)
     horizon: int = 512
 
     @property
@@ -356,7 +358,10 @@ def step(params: HierParams, state: HierState, trace: Trace,
     info = StepInfo(placed=progress | (~progress & ~has_event & forced_ok),
                     dt=dt, in_system_before=n_before,
                     done=all_done(new_state, trace))
-    reward = -(dt * n_before.astype(jnp.float32)) / params.reward_scale
+    # same JCT integrand + placement shaping as the flat env (ADVICE r1:
+    # place_bonus was silently dropped for hierarchical configs)
+    reward = reward_lib.reward_jct(info, params.reward_scale,
+                                   params.place_bonus)
     done = info.done | (new_state.t >= params.horizon)
     obs, mask = _observe(params, new_state, trace)
     ts = TimeStep(obs=obs, reward=reward, done=done, action_mask=mask,

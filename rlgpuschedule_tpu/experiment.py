@@ -76,7 +76,8 @@ def build_stack(cfg: ExperimentConfig):
                             n_placements=cfg.n_placements)
         env_params = hier_lib.HierParams(
             n_pods=cfg.n_pods, pod_sim=pod_sim, time_scale=cfg.time_scale,
-            reward_scale=cfg.reward_scale, horizon=cfg.horizon)
+            reward_scale=cfg.reward_scale, place_bonus=cfg.place_bonus,
+            horizon=cfg.horizon)
         source = validate_trace(pod_sim, load_source_trace(cfg), clamp=True)
         windows = make_env_windows(cfg, source)
         traces = stack_traces(windows, pod_sim)
@@ -352,8 +353,13 @@ class PopulationExperiment:
             if out is not None:
                 self.states, self.hparams, _decision = out
             if log_every and (i % log_every == 0 or i == iterations - 1):
-                m = {k: [float(x) for x in v]
-                     for k, v in metrics._asdict().items()}
+                # flatten per-member values to suffixed scalar columns so
+                # the CSV stays pandas/TensorBoard-ingestible (ADVICE r1)
+                m = {}
+                for k, v in metrics._asdict().items():
+                    vals = [float(x) for x in v]
+                    m.update({f"{k}_{p}": x for p, x in enumerate(vals)})
+                    m[f"{k}_mean"] = sum(vals) / len(vals)
                 history.append({"iteration": i, **m})
                 if logger is not None:
                     logger(i, m)

@@ -76,6 +76,7 @@ def _load() -> ctypes.CDLL | None:
         np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
         ctypes.c_int,
         np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
     ]
     _lib = lib
     return _lib
@@ -92,11 +93,13 @@ def build_error() -> str | None:
 
 
 def run_baseline_native(trace, n_nodes: int, gpus_per_node: int, name: str,
-                        thresholds=_TIRESIAS_THRESHOLDS) -> np.ndarray:
+                        thresholds=_TIRESIAS_THRESHOLDS,
+                        ) -> tuple[np.ndarray, np.ndarray]:
     """Run one named baseline natively over an ArrayTrace; returns per-row
-    finish times [max_jobs] (+inf on padding — all valid jobs complete, as
-    in the oracle). Raises RuntimeError if the engine is unavailable or the
-    trace is infeasible."""
+    ``(finish, start)`` times [max_jobs] (+inf on padding — all valid jobs
+    complete, as in the oracle; ``start`` is the FIRST start, preserved
+    across preemptions, mirroring ``OracleSim.start``). Raises RuntimeError
+    if the engine is unavailable or the trace is infeasible."""
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native engine unavailable: {_build_error}")
@@ -108,27 +111,39 @@ def run_baseline_native(trace, n_nodes: int, gpus_per_node: int, name: str,
     gpus = np.ascontiguousarray(trace.gpus[valid], np.int32)
     th = np.ascontiguousarray(sorted(thresholds), np.float64)
     finish = np.full(len(valid), np.inf, np.float64)
+    start = np.full(len(valid), np.inf, np.float64)
     rc = lib.run_baseline_native(
         len(valid), submit, duration, gpus, n_nodes * gpus_per_node,
-        _POLICIES[name], th, len(th), finish)
+        _POLICIES[name], th, len(th), finish, start)
     if rc < 0:
         reasons = {-1: "invalid input (zero/oversized gang or duration)",
                    -2: "scheduler deadlock", -3: "no progress",
                    -4: "max_events exceeded"}
         raise RuntimeError(f"native {name} failed: "
                            f"{reasons.get(int(rc), rc)}")
-    out = np.full(trace.max_jobs, np.inf, np.float64)
-    out[valid] = finish
-    return out
+    finish_out = np.full(trace.max_jobs, np.inf, np.float64)
+    start_out = np.full(trace.max_jobs, np.inf, np.float64)
+    finish_out[valid] = finish
+    start_out[valid] = start
+    return finish_out, start_out
 
 
 class NativeSimResult:
-    """Finished-run shim with the slice of the OracleSim surface the eval
-    harness reads (finish / jcts / avg_jct)."""
+    """Finished-run shim exposing the OracleSim result surface the eval
+    harness and downstream tools read: ``finish`` / ``start`` / ``status``
+    / ``jcts()`` / ``avg_jct()`` / ``trace`` (the ``sim.schedulers
+    .BaselineResult`` protocol). ``status`` mirrors the oracle's finished
+    state exactly: all rows DONE — valid jobs because the engine runs the
+    trace to completion, padding rows because ``OracleSim.__init__`` marks
+    them DONE from the start (oracle.py:95)."""
 
-    def __init__(self, trace, finish: np.ndarray):
+    def __init__(self, trace, finish: np.ndarray, start: np.ndarray):
+        from ..sim.oracle import DONE
+
         self.trace = trace
         self.finish = np.where(np.isfinite(finish), finish, np.nan)
+        self.start = np.where(np.isfinite(start), start, np.nan)
+        self.status = np.full(trace.max_jobs, DONE, np.int32)
 
     def jcts(self) -> np.ndarray:
         v = self.trace.valid & np.isfinite(self.finish)

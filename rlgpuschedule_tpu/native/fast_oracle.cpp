@@ -27,7 +27,9 @@
 // C ABI (ctypes, see native/__init__.py):
 //   run_baseline_native(n_jobs, submit[], duration[], gpus[],
 //                       capacity, policy, thresholds[], n_thresholds,
-//                       finish_out[]) -> events (>=0) or error (<0)
+//                       finish_out[], start_out[]) -> events (>=0) or
+//                       error (<0); start_out = first-start times (the
+//                       OracleSim.start surface; +inf if never started)
 
 #include <algorithm>
 #include <cmath>
@@ -66,6 +68,7 @@ struct Engine {
   std::vector<int8_t> status;
   std::vector<double> remaining;
   std::vector<double> finish;
+  std::vector<double> start;
   double clock = 0.0;
   int free_total;
   int n_done = 0;
@@ -101,6 +104,7 @@ struct Engine {
     status.assign(n, NOT_ARRIVED);
     remaining.assign(n, 0.0);
     finish.assign(n, INF);
+    start.assign(n, INF);
     for (int j = 0; j < n; ++j) remaining[j] = duration[j];
     free_total = capacity;
     arrival_order.resize(n);
@@ -157,6 +161,7 @@ struct Engine {
   void place(int j) {  // caller guarantees demand <= free_total
     free_total -= gpus[j];
     status[j] = RUNNING;
+    start[j] = std::min(start[j], clock);
     running.push_back(j);
   }
 
@@ -261,7 +266,7 @@ struct Engine {
 extern "C" int64_t run_baseline_native(
     int n_jobs, const double* submit, const double* duration,
     const int* gpus, int capacity, int policy, const double* thresholds,
-    int n_thresholds, double* finish_out) {
+    int n_thresholds, double* finish_out, double* start_out) {
   if (n_jobs < 0 || capacity <= 0 || policy < 0 || policy > 3) return -1;
   for (int j = 0; j < n_jobs; ++j)
     if (gpus[j] > capacity || gpus[j] <= 0 || duration[j] <= 0.0) return -1;
@@ -276,6 +281,9 @@ extern "C" int64_t run_baseline_native(
   std::sort(eng.thresholds.begin(), eng.thresholds.end());
   const int64_t events = eng.run(10'000'000LL);
   if (events < 0) return events;
-  for (int j = 0; j < n_jobs; ++j) finish_out[j] = eng.finish[j];
+  for (int j = 0; j < n_jobs; ++j) {
+    finish_out[j] = eng.finish[j];
+    start_out[j] = eng.start[j];
+  }
   return events;
 }

@@ -15,11 +15,29 @@ tests").
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Sequence
+from typing import Callable, Protocol, Sequence, runtime_checkable
 
 import numpy as np
 
 from .oracle import OracleSim, PACK, PENDING, RUNNING
+
+
+@runtime_checkable
+class BaselineResult(Protocol):
+    """The finished-run surface every ``run_baseline`` backend returns.
+
+    Both ``OracleSim`` (python backend) and ``native.NativeSimResult``
+    (C++ backend) satisfy this, so callers can depend on it regardless of
+    which backend a host selects (ADVICE r1: backend='auto' previously
+    returned divergent surfaces, failing only on compiler-equipped
+    machines)."""
+    trace: "object"
+    finish: np.ndarray   # per-row completion times (NaN/inf on padding)
+    start: np.ndarray    # per-row FIRST start times
+    status: np.ndarray   # oracle status codes (DONE for completed jobs)
+
+    def jcts(self) -> np.ndarray: ...
+    def avg_jct(self) -> float: ...
 
 
 @dataclasses.dataclass
@@ -132,7 +150,7 @@ def run_scheduler(sim: OracleSim, policy: SchedulerPolicy,
 
 
 def run_baseline(trace, n_nodes: int, gpus_per_node: int, name: str,
-                 backend: str = "auto"):
+                 backend: str = "auto") -> BaselineResult:
     """Run one named baseline over a trace; returns the finished sim (the
     single implementation behind every baseline JCT table).
 
@@ -140,8 +158,7 @@ def run_baseline(trace, n_nodes: int, gpus_per_node: int, name: str,
     ~100× the Python oracle on production-scale traces) when a toolchain is
     present, falling back to the oracle; "python" / "native" force one.
     Both backends implement identical semantics (cross-validated in
-    tests/test_native.py); the returned object exposes at least
-    ``finish`` / ``jcts()`` / ``avg_jct()`` / ``trace``."""
+    tests/test_native.py) and return the :class:`BaselineResult` surface."""
     if backend not in ("auto", "python", "native"):
         raise ValueError(f"unknown backend {backend!r}")
     if backend != "python":
@@ -150,9 +167,9 @@ def run_baseline(trace, n_nodes: int, gpus_per_node: int, name: str,
             from ..traces.records import ArrayTrace, to_array_trace
             tr = trace if isinstance(trace, ArrayTrace) else \
                 to_array_trace(trace)
-            finish = native.run_baseline_native(tr, n_nodes, gpus_per_node,
-                                                name)
-            return native.NativeSimResult(tr, finish)
+            finish, start = native.run_baseline_native(
+                tr, n_nodes, gpus_per_node, name)
+            return native.NativeSimResult(tr, finish, start)
         if backend == "native":
             raise RuntimeError(
                 f"native backend unavailable: {native.build_error()}")

@@ -109,6 +109,21 @@ class TestHierMechanics:
         # conservation in the untouched pod
         assert int(jnp.sum(state.pods.free[1])) == p.pod_capacity
 
+    def test_place_bonus_shapes_reward(self):
+        """ADVICE r1: place_bonus must reach the hierarchical reward.
+        Routing is a progress step (dt=0, placed=True), so with a bonus
+        the reward is exactly +bonus; without it, 0."""
+        p0 = make_params()
+        pb = dataclasses.replace(p0, place_bonus=0.25)
+        tr = dev_trace(tiny_trace(), p0)
+        a = noop_actions(p0) | {"top": jnp.int32(1)}
+        s0, _ = hier.reset(p0, tr)
+        _, ts0 = hier.step(p0, s0, tr, a)
+        sb, _ = hier.reset(pb, tr)
+        _, tsb = hier.step(pb, sb, tr, a)
+        assert float(ts0.reward) == pytest.approx(0.0)
+        assert float(tsb.reward) == pytest.approx(0.25)
+
     def test_noop_advances_to_completion(self):
         p = make_params()
         tr = dev_trace(tiny_trace(), p)

@@ -32,7 +32,12 @@ class TestCrossValidation:
             np.where(np.isnan(nat.finish), np.inf, nat.finish)[tr.valid],
             np.where(np.isnan(py.finish), np.inf, py.finish)[tr.valid],
             rtol=0, atol=1e-6)
+        np.testing.assert_allclose(
+            np.where(np.isnan(nat.start), np.inf, nat.start)[tr.valid],
+            np.where(np.isnan(py.start), np.inf, py.start)[tr.valid],
+            rtol=0, atol=1e-6)
         assert nat.avg_jct() == pytest.approx(py.avg_jct(), rel=1e-9)
+        np.testing.assert_array_equal(nat.status, py.status)
 
     @pytest.mark.parametrize("name", POLICIES)
     def test_underloaded_trace(self, name):

@@ -174,7 +174,11 @@ class TestPopulationExperiment:
         assert len(out["final_fitness"]) == 4
         assert all(np.isfinite(out["final_fitness"]))
         for h in out["history"]:
-            assert all(np.isfinite(h["mean_reward"]))
+            # per-member metrics are flattened to scalar columns (CSV-safe)
+            member_vals = [h[f"mean_reward_{p}"] for p in range(4)]
+            assert all(np.isfinite(member_vals))
+            assert all(isinstance(v, float) for v in member_vals)
+            assert np.isfinite(h["mean_reward_mean"])
 
     def test_single_device_path(self):
         cfg = dataclasses.replace(TINY, iterations=2)
