@@ -30,6 +30,7 @@ class ExperimentConfig:
     n_envs: int = 4
     queue_len: int = 8
     n_placements: int = 1
+    preempt_len: int = 0                # >0 = preemptive RL action space
     n_pods: int = 1                     # >1 = hierarchical env (config 5)
     obs_kind: Literal["flat", "grid", "graph"] = "flat"
     reward_kind: Literal["jct", "fair"] = "jct"
@@ -81,6 +82,14 @@ GNN_GANG_PLACE = _register(ExperimentConfig(
     name="gnn-gang-place", algo="ppo", n_nodes=16, gpus_per_node=8,
     trace="synthetic", n_envs=4, obs_kind="graph", n_placements=2,
     nodes_per_rack=4, window_jobs=64))
+
+# Preemptive variant of config 1: the agent can also evict the R most-
+# attained running jobs (sim.core.running_queue), like Tiresias' demotions
+# but learned (VERDICT r1 missing #5 — Tiresias preempts, so a policy that
+# cannot is handicapped on overloaded traces).
+PPO_MLP_PREEMPT = _register(ExperimentConfig(
+    name="ppo-mlp-preempt", algo="ppo", n_nodes=8, gpus_per_node=8,
+    trace="synthetic", n_envs=4, obs_kind="flat", preempt_len=4))
 
 # 5. Hierarchical multi-agent across 4 pods + PBT: each population member
 # IS a hierarchical agent (top-level router + shared per-pod placers) over

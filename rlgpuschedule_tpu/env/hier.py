@@ -311,7 +311,8 @@ def reset(params: HierParams, trace: Trace) -> tuple[HierState, TimeStep]:
                       t=jnp.int32(0))
     info = StepInfo(placed=jnp.bool_(False), dt=jnp.float32(0.0),
                     in_system_before=in_system(state, trace),
-                    done=jnp.bool_(False))
+                    done=jnp.bool_(False), preempted=jnp.bool_(False),
+                    first_placed=jnp.bool_(False))
     obs, mask = _observe(params, state, trace)
     ts = TimeStep(obs=obs, reward=jnp.float32(0.0), done=jnp.bool_(False),
                   action_mask=mask, info=info)
@@ -355,9 +356,12 @@ def step(params: HierParams, state: HierState, trace: Trace,
     new_state = jax.tree.map(pick, acted, advanced, forced)
     new_state = new_state._replace(t=state.t + 1)
     dt = jnp.where(progress | ~has_event, 0.0, t_next - clock)
-    info = StepInfo(placed=progress | (~progress & ~has_event & forced_ok),
-                    dt=dt, in_system_before=n_before,
-                    done=all_done(new_state, trace))
+    acted_ok = progress | (~progress & ~has_event & forced_ok)
+    # no preemption in the hierarchy, so every progress step is "first"
+    # (a job routes once and places once — the bonus stays bounded)
+    info = StepInfo(placed=acted_ok, dt=dt, in_system_before=n_before,
+                    done=all_done(new_state, trace),
+                    preempted=jnp.bool_(False), first_placed=acted_ok)
     # same JCT integrand + placement shaping as the flat env (ADVICE r1:
     # place_bonus was silently dropped for hierarchical configs)
     reward = reward_lib.reward_jct(info, params.reward_scale,

@@ -11,8 +11,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..sim.core import (SimParams, SimState, Trace, pending_queue, RUNNING,
-                        in_system, utilization)
+from ..sim.core import (SimParams, SimState, Trace, pending_queue,
+                        running_queue, RUNNING, in_system, utilization)
 
 
 def queue_features(params: SimParams, state: SimState, trace: Trace,
@@ -34,9 +34,31 @@ def queue_features(params: SimParams, state: SimState, trace: Trace,
     return jnp.stack([demand, wait, service, valid], axis=1)
 
 
+def run_features(params: SimParams, state: SimState, trace: Trace,
+                 time_scale: float, run_queue: jax.Array | None = None,
+                 ) -> jax.Array:
+    """Per-preempt-slot features [R, 4] over :func:`running_queue` (most
+    attained GPU-service first): demand/capacity, executed seconds,
+    remaining seconds (both tanh-squashed by ``time_scale``), valid — what
+    the agent needs to judge a demotion."""
+    if run_queue is None:
+        run_queue = running_queue(params, state, trace)     # [R]
+    jc = jnp.clip(run_queue, 0, params.max_jobs - 1)
+    occupied = run_queue >= 0
+    valid = occupied.astype(jnp.float32)
+    demand = trace.gpus[jc].astype(jnp.float32) / params.capacity * valid
+    executed = jnp.where(occupied,
+                         trace.duration[jc] - state.remaining[jc], 0.0)
+    remaining = jnp.where(occupied, state.remaining[jc], 0.0)
+    return jnp.stack([demand, jnp.tanh(executed / time_scale),
+                      jnp.tanh(remaining / time_scale), valid], axis=1)
+
+
 def flat_obs(params: SimParams, state: SimState, trace: Trace,
-             time_scale: float, queue: jax.Array | None = None) -> jax.Array:
-    """[N + 4K + 2] vector: per-node free fraction, queue features,
+             time_scale: float, queue: jax.Array | None = None,
+             run_queue: jax.Array | None = None) -> jax.Array:
+    """[N + 4K + 4R + 2] vector: per-node free fraction, queue features,
+    running-job features (preemptive configs, R = preempt_len),
     utilization, normalized in-system count."""
     free_frac = state.free.astype(jnp.float32) / params.gpus_per_node
     qf = queue_features(params, state, trace, queue)
@@ -44,19 +66,26 @@ def flat_obs(params: SimParams, state: SimState, trace: Trace,
     qf = qf.at[:, 2].set(jnp.tanh(qf[:, 2] / time_scale))
     util = utilization(params, state)
     n_insys = in_system(state) / params.max_jobs
-    return jnp.concatenate([free_frac, qf.reshape(-1),
-                            jnp.stack([util, n_insys])]).astype(jnp.float32)
+    parts = [free_frac, qf.reshape(-1)]
+    if params.preempt_len:
+        parts.append(run_features(params, state, trace, time_scale,
+                                  run_queue).reshape(-1))
+    parts.append(jnp.stack([util, n_insys]))
+    return jnp.concatenate(parts).astype(jnp.float32)
 
 
 def grid_obs(params: SimParams, state: SimState, trace: Trace,
-             time_scale: float, queue: jax.Array | None = None) -> jax.Array:
-    """Occupancy image [N + K, G, 2] (the reference's CNN input shape class —
-    cluster occupancy stacked over queue-demand rows, SURVEY.md §2):
+             time_scale: float, queue: jax.Array | None = None,
+             run_queue: jax.Array | None = None) -> jax.Array:
+    """Occupancy image [N + K (+ R), G, 2] (the reference's CNN input shape
+    class — cluster occupancy stacked over queue-demand rows, SURVEY.md §2):
 
     cluster rows n<N:  ch0 = GPU slot occupied; ch1 = node-average normalized
                        remaining service painted on occupied slots.
     queue rows:        ch0 = demand bar (capped at G); ch1 = normalized
                        service demand painted on the bar.
+    preempt rows (preemptive configs): ch0 = demand bar of running-queue
+                       slots; ch1 = normalized remaining service on the bar.
     """
     N, G, K = params.n_nodes, params.gpus_per_node, params.queue_len
     used = (params.gpus_per_node - state.free).astype(jnp.float32)    # [N]
@@ -76,16 +105,28 @@ def grid_obs(params: SimParams, state: SimState, trace: Trace,
     bar = (slots[None, :] < demand[:, None]).astype(jnp.float32)      # [K,G]
     service = jnp.tanh(trace.duration[jc] / time_scale) * valid
     qimg = jnp.stack([bar, bar * service[:, None]], axis=-1)          # [K,G,2]
-    return jnp.concatenate([cluster, qimg], axis=0)                   # [N+K,G,2]
+    parts = [cluster, qimg]
+    if params.preempt_len:
+        if run_queue is None:
+            run_queue = running_queue(params, state, trace)
+        rc = jnp.clip(run_queue, 0, params.max_jobs - 1)
+        rvalid = (run_queue >= 0).astype(jnp.float32)
+        rdemand = jnp.minimum(trace.gpus[rc], G).astype(jnp.float32) * rvalid
+        rbar = (slots[None, :] < rdemand[:, None]).astype(jnp.float32)
+        rrem = jnp.tanh(state.remaining[rc] / time_scale) * rvalid
+        parts.append(jnp.stack([rbar, rbar * rrem[:, None]], axis=-1))
+    return jnp.concatenate(parts, axis=0)                     # [N+K+R,G,2]
 
 
 def build_adjacency(n_nodes: int, queue_len: int,
-                    nodes_per_rack: int | None = None) -> np.ndarray:
-    """Static topology adjacency [V, V], V = N + K: cluster nodes connected
-    within a rack (all-to-all if ``nodes_per_rack`` is None), every queue slot
-    connected to every cluster node (placement candidates), self-loops.
-    Static because cluster topology never changes — only features do."""
-    V = n_nodes + queue_len
+                    nodes_per_rack: int | None = None,
+                    preempt_len: int = 0) -> np.ndarray:
+    """Static topology adjacency [V, V], V = N + K + R: cluster nodes
+    connected within a rack (all-to-all if ``nodes_per_rack`` is None),
+    every queue slot and every running (preempt) slot connected to every
+    cluster node (placement / eviction candidates), self-loops. Static
+    because cluster topology never changes — only features do."""
+    V = n_nodes + queue_len + preempt_len
     a = np.zeros((V, V), np.float32)
     if nodes_per_rack is None:
         a[:n_nodes, :n_nodes] = 1.0
@@ -93,7 +134,7 @@ def build_adjacency(n_nodes: int, queue_len: int,
         for r0 in range(0, n_nodes, nodes_per_rack):
             r1 = min(r0 + nodes_per_rack, n_nodes)
             a[r0:r1, r0:r1] = 1.0
-    a[:n_nodes, n_nodes:] = 1.0   # node ↔ queue bipartite
+    a[:n_nodes, n_nodes:] = 1.0   # node ↔ {queue, running} bipartite
     a[n_nodes:, :n_nodes] = 1.0
     np.fill_diagonal(a, 1.0)
     return a
@@ -103,10 +144,13 @@ GRAPH_FEATURES = 5
 
 
 def graph_obs(params: SimParams, state: SimState, trace: Trace,
-              time_scale: float, queue: jax.Array | None = None) -> jax.Array:
-    """Node-feature matrix [N + K, 5] over the static topology graph:
+              time_scale: float, queue: jax.Array | None = None,
+              run_queue: jax.Array | None = None) -> jax.Array:
+    """Node-feature matrix [N + K (+ R), 5] over the static topology graph:
     cluster rows: [free_frac, used_frac, avg_remaining, 1, 0];
-    queue rows:   [demand/capacity, wait, service, 0, 1] (times tanh-squashed).
+    queue rows:   [demand/capacity, wait, service, 0, 1] (times tanh-squashed);
+    preempt rows: [demand/capacity, executed, remaining, 0, 0] (type flags
+    both 0 distinguish running slots from cluster/queue rows).
     The adjacency comes from :func:`build_adjacency` (static)."""
     N, G = params.n_nodes, params.gpus_per_node
     free_frac = state.free.astype(jnp.float32) / G
@@ -123,4 +167,10 @@ def graph_obs(params: SimParams, state: SimState, trace: Trace,
     service = jnp.tanh(qf[:, 2] / time_scale)
     zeros = jnp.zeros((params.queue_len,), jnp.float32)
     queue = jnp.stack([qf[:, 0], wait, service, zeros, qf[:, 3]], axis=1)
-    return jnp.concatenate([cluster, queue], axis=0)           # [N+K,5]
+    parts = [cluster, queue]
+    if params.preempt_len:
+        rf = run_features(params, state, trace, time_scale, run_queue)
+        rzeros = jnp.zeros((params.preempt_len,), jnp.float32)
+        parts.append(jnp.stack([rf[:, 0], rf[:, 1], rf[:, 2],
+                                rzeros, rzeros], axis=1))
+    return jnp.concatenate(parts, axis=0)                      # [N+K+R,5]

@@ -17,17 +17,23 @@ def reward_jct(info: StepInfo, reward_scale: float,
     ``-dt · n_in_system`` over decision intervals makes the (undiscounted)
     episode return equal −Σ JCT / scale.
 
-    ``place_bonus`` adds a small reward per successful placement. Without
-    preemption a job is placed at most once, so the bonus telescopes to a
-    per-episode constant for every policy that schedules all jobs — it is
-    potential-based shaping (φ = bonus · #placed) that gives the actor
+    ``place_bonus`` adds a small reward per FIRST placement of a job
+    (``info.first_placed``): the shaping potential is φ = bonus ·
+    #{jobs ever started}, which only ever increments and is bounded by the
+    job count, so the bonus telescopes to a per-episode constant for every
+    policy that schedules all jobs — including under the preemptive action
+    space, where paying on every placement would let a zero-time
+    preempt→re-place cycle farm unbounded reward. It gives the actor
     immediate credit for admitting work instead of waiting for that credit
-    to propagate through the critic. Empirically this breaks the
+    to propagate through the critic; empirically this breaks the
     idle-until-drained local optimum (policy no-ops ~50% of feasible steps
-    without it)."""
+    without it). NOTE: with episodes cut at the env horizon the telescoping
+    argument is approximate at the boundary — eval replay (eval.py) scores
+    policies with the unshaped JCT objective, so reported JCT numbers are
+    unaffected."""
     base = -(info.dt * info.in_system_before.astype(jnp.float32)) / reward_scale
     if place_bonus:
-        return base + place_bonus * info.placed.astype(jnp.float32)
+        return base + place_bonus * info.first_placed.astype(jnp.float32)
     return base
 
 

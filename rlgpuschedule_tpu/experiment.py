@@ -27,7 +27,8 @@ from flax.training.train_state import TrainState
 def build_env_params(cfg: ExperimentConfig) -> EnvParams:
     sim = SimParams(n_nodes=cfg.n_nodes, gpus_per_node=cfg.gpus_per_node,
                     max_jobs=cfg.window_jobs, queue_len=cfg.queue_len,
-                    n_placements=cfg.n_placements)
+                    n_placements=cfg.n_placements,
+                    preempt_len=cfg.preempt_len)
     return EnvParams(sim=sim, obs_kind=cfg.obs_kind,
                      reward_kind=cfg.reward_kind, n_tenants=cfg.n_tenants,
                      time_scale=cfg.time_scale, reward_scale=cfg.reward_scale,
@@ -69,6 +70,11 @@ def build_stack(cfg: ExperimentConfig):
                 f"hierarchical configs use flat pod observations and the "
                 f"JCT reward; got obs_kind={cfg.obs_kind!r}, "
                 f"reward_kind={cfg.reward_kind!r}")
+        if cfg.preempt_len:
+            raise ValueError(
+                "hierarchical configs do not support the preemptive action "
+                "space (pod actions are queue-slot×placement + no-op); set "
+                "preempt_len=0")
         pod_sim = SimParams(n_nodes=cfg.n_nodes // cfg.n_pods,
                             gpus_per_node=cfg.gpus_per_node,
                             max_jobs=cfg.window_jobs,
@@ -93,10 +99,12 @@ def build_stack(cfg: ExperimentConfig):
     traces = stack_traces(windows, env_params)
     net = make_policy(cfg.obs_kind, env_params.n_actions,
                       n_cluster_nodes=cfg.n_nodes, queue_len=cfg.queue_len,
-                      n_placements=cfg.n_placements)
+                      n_placements=cfg.n_placements,
+                      preempt_len=cfg.preempt_len)
     if cfg.obs_kind == "graph":
         adj = jnp.asarray(build_adjacency(cfg.n_nodes, cfg.queue_len,
-                                          cfg.nodes_per_rack))
+                                          cfg.nodes_per_rack,
+                                          cfg.preempt_len))
         apply_fn = lambda p, obs, mask: net.apply(p, obs, adj, mask)
         extra = (adj,)
     else:

@@ -47,12 +47,14 @@ class GNNActorCritic(nn.Module):
     slot's own node embedding (slots are graph nodes N..N+K-1), so the
     policy is equivariant over queue slots; with ``n_placements`` > 1 each
     slot head emits pack/spread logits (the factored gang-scheduling +
-    placement action space). The no-op logit and value come from the pooled
-    graph embedding."""
+    placement action space). With ``preempt_len`` > 0, per-running-slot
+    preempt logits come from the running-slot nodes N+K..N+K+R-1 the same
+    way. The no-op logit and value come from the pooled graph embedding."""
     encoder: GNNEncoder
     n_cluster_nodes: int
     queue_len: int
     n_placements: int = 1
+    preempt_len: int = 0
 
     @nn.compact
     def __call__(self, obs: jax.Array, adj: jax.Array, mask: jax.Array
@@ -64,11 +66,19 @@ class GNNActorCritic(nn.Module):
         slot_logits = nn.Dense(self.n_placements, dtype=jnp.float32,
                                kernel_init=nn.initializers.orthogonal(0.01),
                                name="slot_policy")(slots)
-        flat = slot_logits.reshape(*slot_logits.shape[:-2], -1)  # [..., K*P]
+        parts = [slot_logits.reshape(*slot_logits.shape[:-2], -1)]  # [..., K*P]
+        if self.preempt_len:
+            run0 = self.n_cluster_nodes + self.queue_len
+            runs = h[..., run0:run0 + self.preempt_len, :]   # [..., R, D]
+            pre = nn.Dense(1, dtype=jnp.float32,
+                           kernel_init=nn.initializers.orthogonal(0.01),
+                           name="preempt_policy")(runs)
+            parts.append(pre.squeeze(-1))                    # [..., R]
         noop = nn.Dense(1, dtype=jnp.float32,
                         kernel_init=nn.initializers.orthogonal(0.01),
                         name="noop_policy")(pooled)
-        logits = jnp.concatenate([flat, noop], axis=-1)
+        parts.append(noop)
+        logits = jnp.concatenate(parts, axis=-1)
         value = nn.Dense(1, dtype=jnp.float32,
                          kernel_init=nn.initializers.orthogonal(1.0),
                          name="value")(pooled)
@@ -77,7 +87,7 @@ class GNNActorCritic(nn.Module):
 
 def make_policy(obs_kind: str, n_actions: int, *, n_cluster_nodes: int = 0,
                 queue_len: int = 0, n_placements: int = 1,
-                dtype=jnp.bfloat16) -> nn.Module:
+                preempt_len: int = 0, dtype=jnp.bfloat16) -> nn.Module:
     """Encoder-selection factory matching EnvParams.obs_kind."""
     if obs_kind == "flat":
         return ActorCritic(MLPEncoder(dtype=dtype), n_actions)
@@ -85,5 +95,5 @@ def make_policy(obs_kind: str, n_actions: int, *, n_cluster_nodes: int = 0,
         return ActorCritic(CNNEncoder(dtype=dtype), n_actions)
     if obs_kind == "graph":
         return GNNActorCritic(GNNEncoder(dtype=dtype), n_cluster_nodes,
-                              queue_len, n_placements)
+                              queue_len, n_placements, preempt_len)
     raise ValueError(f"unknown obs_kind {obs_kind!r}")

@@ -42,6 +42,8 @@ class SimParams:
     max_jobs: int          # J: rows in the (padded) job table
     queue_len: int = 16    # K: pending-queue slots visible to the agent
     n_placements: int = 1  # P: 1 = pack only; 2 = pack|spread factored action
+    preempt_len: int = 0   # R: running-job slots the agent may preempt
+    #                        (0 = non-preemptive action space, the default)
 
     @property
     def capacity(self) -> int:
@@ -49,7 +51,8 @@ class SimParams:
 
     @property
     def n_actions(self) -> int:
-        return self.queue_len * self.n_placements + 1  # + no-op
+        # [K*P placements][R preemptions][no-op] — see rl_step
+        return self.queue_len * self.n_placements + self.preempt_len + 1
 
 
 class Trace(NamedTuple):
@@ -109,6 +112,11 @@ class StepInfo(NamedTuple):
     dt: jax.Array               # f32 — simulated time advanced
     in_system_before: jax.Array # i32 — arrived-not-done count during [t, t+dt)
     done: jax.Array             # bool — all valid jobs DONE
+    preempted: jax.Array        # bool — action preempted a running job
+    first_placed: jax.Array     # bool — placed a job that had NEVER run
+    #   (drives place_bonus: re-placing a preempted job earns nothing, so
+    #    the shaping potential Φ = bonus·#{ever-started} still telescopes
+    #    and a preempt→re-place cycle cannot farm reward)
 
 
 # ---- lifecycle --------------------------------------------------------------
@@ -286,6 +294,26 @@ def pending_queue(params: SimParams, state: SimState) -> jax.Array:
         jnp.where(pending & (rank < K), rows, -1), mode="drop")[:K]
 
 
+def running_queue(params: SimParams, state: SimState, trace: Trace,
+                  ) -> jax.Array:
+    """Row indices of the R running jobs with the MOST attained GPU-service
+    (ties → lowest row id), -1 padded — the slots the preemptive action
+    space indexes into. Most-served-first is the Tiresias demotion order:
+    preempting slot 0 evicts the long-runner to make room for short work
+    (attained service is preserved, so nothing is lost)."""
+    R = params.preempt_len
+    running = state.status == RUNNING
+    key = jnp.where(running, attained_service(state, trace), -INF)
+    order = jnp.argsort(-key)                  # stable: ties → row asc
+    rows = order[:R].astype(jnp.int32)
+    # NOTE: the sort key is f32 (device state) while OracleSim.running_queue
+    # sorts in f64; the bit-identical-equivalence contract therefore holds
+    # on integer-valued traces (where f32 time is exact — the property-test
+    # regime, tests/test_sim_core.py), not on arbitrary float traces where
+    # two attained-service values may tie in f32 but differ in f64.
+    return jnp.where(running[rows], rows, -1)
+
+
 def in_system(state: SimState) -> jax.Array:
     return jnp.sum((state.status == PENDING) | (state.status == RUNNING))
 
@@ -301,18 +329,26 @@ def attained_service(state: SimState, trace: Trace) -> jax.Array:
 
 
 def action_mask(params: SimParams, state: SimState, trace: Trace,
-                queue: jax.Array | None = None) -> jax.Array:
+                queue: jax.Array | None = None,
+                run_queue: jax.Array | None = None) -> jax.Array:
     """bool[n_actions]: queue-slot actions valid iff the slot holds a pending
     job whose gang fits in the free GPUs (pack and spread share feasibility:
-    jobs may span nodes). No-op is always valid. Pass a precomputed
-    ``pending_queue`` to share it with the observation builder."""
+    jobs may span nodes); preempt slots valid iff they hold a running job;
+    no-op is always valid. Pass precomputed ``pending_queue`` /
+    ``running_queue`` to share them with the observation builder."""
     if queue is None:
         queue = pending_queue(params, state)                   # [K]
     jc = jnp.clip(queue, 0, params.max_jobs - 1)
     demand = trace.gpus[jc]
     ok = (queue >= 0) & (demand <= jnp.sum(state.free))        # [K]
     slots = jnp.repeat(ok, params.n_placements)                # [K*P]
-    return jnp.concatenate([slots, jnp.ones((1,), bool)])
+    parts = [slots]
+    if params.preempt_len:
+        if run_queue is None:
+            run_queue = running_queue(params, state, trace)    # [R]
+        parts.append(run_queue >= 0)
+    parts.append(jnp.ones((1,), bool))
+    return jnp.concatenate(parts)
 
 
 # ---- the RL decision-point step --------------------------------------------
@@ -321,18 +357,35 @@ def rl_step(params: SimParams, state: SimState, trace: Trace,
             action: jax.Array) -> tuple[SimState, StepInfo]:
     """One decision-point step; exact jit/vmap analogue of
     ``OracleSim.rl_step`` (see its docstring for the semantics). Branchless:
-    both outcomes (placement vs time-advance) are computed and masked —
-    the idiomatic XLA trade against host control flow."""
-    K, P = params.queue_len, params.n_placements
+    every outcome (placement vs preemption vs time-advance) is computed and
+    masked — the idiomatic XLA trade against host control flow.
+
+    Action layout: ``[K*P placements][R preemptions][no-op]``. Placements
+    and preemptions cost no simulated time (the agent acts again at the
+    same instant); preemption targets ``running_queue`` slots. The R block
+    exists only when ``params.preempt_len > 0``, so non-preemptive configs
+    trace the exact same XLA program as before."""
+    K, P, R = params.queue_len, params.n_placements, params.preempt_len
+    n_place = K * P
     queue = pending_queue(params, state)
-    is_noop = action >= K * P
+    is_place = action < n_place
     k = jnp.clip(action // P, 0, K - 1)
     mode = action % P
-    j = jnp.where(is_noop, -1, queue[k])
+    j = jnp.where(is_place, queue[k], -1)
 
     placed_state, placed = try_place(params, state, trace, j, mode)
 
-    # not placed → advance to next event, or force-place queue head if the
+    if R:
+        run_q = running_queue(params, state, trace)
+        is_pre = ~is_place & (action < n_place + R)
+        r = jnp.clip(action - n_place, 0, R - 1)
+        pre_state, preempted = preempt(
+            state, jnp.where(is_pre, run_q[r], -1), params.max_jobs)
+    else:
+        preempted = jnp.bool_(False)
+    progress = placed | preempted
+
+    # no progress → advance to next event, or force-place queue head if the
     # event horizon is empty (nothing running ⇒ cluster free ⇒ feasible for
     # any job with demand ≤ capacity — validate_trace enforces that on host;
     # an over-capacity job would make forced_ok False and the episode can
@@ -344,14 +397,34 @@ def rl_step(params: SimParams, state: SimState, trace: Trace,
     forced_state, forced_ok = try_place(params, state, trace, queue[0],
                                         jnp.int32(PACK))
 
-    def pick(a, b, c):  # placed ? a : (has_event ? b : c)
-        return jnp.where(placed, a, jnp.where(has_event, b, c))
+    if R:
+        def pick(a, p, b, c):
+            # placed ? a : preempted ? p : (has_event ? b : c)
+            return jnp.where(placed, a, jnp.where(
+                preempted, p, jnp.where(has_event, b, c)))
 
-    new_state = jax.tree.map(pick, placed_state, advanced_state, forced_state)
-    dt = jnp.where(placed | ~has_event, 0.0, t_next - state.clock)
-    info = StepInfo(placed=placed | (~placed & ~has_event & forced_ok),
+        new_state = jax.tree.map(pick, placed_state, pre_state,
+                                 advanced_state, forced_state)
+    else:
+        def pick(a, b, c):  # placed ? a : (has_event ? b : c)
+            return jnp.where(placed, a, jnp.where(has_event, b, c))
+
+        new_state = jax.tree.map(pick, placed_state, advanced_state,
+                                 forced_state)
+    dt = jnp.where(progress | ~has_event, 0.0, t_next - state.clock)
+    # "first" = the job had never run before this step (start still +inf);
+    # try_place keeps the original start on re-placement, so this reads the
+    # pre-step state
+    never_ran = ~jnp.isfinite(state.start)
+    first_sel = never_ran[jnp.clip(j, 0, params.max_jobs - 1)]
+    first_head = never_ran[jnp.clip(queue[0], 0, params.max_jobs - 1)]
+    forced_fire = ~progress & ~has_event & forced_ok
+    info = StepInfo(placed=placed | forced_fire,
                     dt=dt, in_system_before=n_before,
-                    done=all_done(new_state, trace))
+                    done=all_done(new_state, trace),
+                    preempted=preempted,
+                    first_placed=(placed & first_sel)
+                    | (forced_fire & first_head))
     return new_state, info
 
 

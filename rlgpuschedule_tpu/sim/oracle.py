@@ -169,38 +169,53 @@ class OracleSim:
         self.status[j] = PENDING
         return True
 
-    def rl_step(self, action: int, queue_len: int, n_placements: int = 1
-                ) -> dict:
+    def rl_step(self, action: int, queue_len: int, n_placements: int = 1,
+                n_preempt: int = 0) -> dict:
         """One RL decision-point step — the reference semantics that the
         jitted ``sim.core.rl_step`` must reproduce exactly (SURVEY.md §3.2).
 
-        Action encoding: ``action == queue_len * n_placements`` is no-op;
-        otherwise slot ``action // n_placements`` of the pending queue with
-        placement mode ``action % n_placements`` (0=pack, 1=spread).
+        Action layout ``[K*P placements][R preemptions][no-op]``:
+        ``action < K*P`` places slot ``action // n_placements`` of the
+        pending queue with mode ``action % n_placements`` (0=pack,
+        1=spread); ``K*P <= action < K*P + n_preempt`` preempts slot
+        ``action - K*P`` of the running queue (most attained GPU-service
+        first — the Tiresias demotion order); anything else is no-op.
 
-        Semantics: a successful placement costs no simulated time (the agent
-        acts again at the same instant). A no-op / invalid / infeasible action
-        advances the clock to the next event. If no future event exists
-        (nothing running ⇒ cluster fully free) the head-of-queue job is
-        force-placed to guarantee progress — it is always feasible because
-        per-job demand ≤ capacity is enforced at construction.
+        Semantics: a successful placement or preemption costs no simulated
+        time (the agent acts again at the same instant). A no-op / invalid
+        / infeasible action advances the clock to the next event. If no
+        future event exists (nothing running ⇒ cluster fully free) the
+        head-of-queue job is force-placed to guarantee progress — it is
+        always feasible because per-job demand ≤ capacity is enforced at
+        construction.
         """
+        n_place = queue_len * n_placements
         queue = self.pending_jobs()[:queue_len]
-        placed = False
-        if action < queue_len * n_placements:
+        placed = preempted = first_placed = False
+        if action < n_place:
             k, p = divmod(action, n_placements)
             if k < len(queue):
+                first = bool(np.isnan(self.start[queue[k]]))
                 placed = self.try_place(queue[k], p)
+                first_placed = placed and first
+        elif action < n_place + n_preempt:
+            run_q = self.running_queue(n_preempt)
+            r = action - n_place
+            if r < len(run_q):
+                preempted = self.preempt(run_q[r])
         dt, n_before = 0.0, self.in_system()
-        if not placed:
+        if not (placed or preempted):
             t = self.next_event_time()
             if np.isfinite(t):
                 dt = self.advance_to(t)
             elif queue:
+                first = bool(np.isnan(self.start[queue[0]]))
                 assert self.try_place(queue[0], PACK)
                 placed = True
+                first_placed = first
         return {"placed": placed, "dt": dt, "in_system_before": n_before,
-                "done": self.done()}
+                "done": self.done(), "preempted": preempted,
+                "first_placed": first_placed}
 
     # ---- queries -----------------------------------------------------------
 
@@ -212,6 +227,14 @@ class OracleSim:
 
     def running_jobs(self) -> list[int]:
         return list(np.flatnonzero(self.status == RUNNING))
+
+    def running_queue(self, n_preempt: int) -> list[int]:
+        """Running job ids ordered by attained GPU-service DESC (ties → id
+        asc) — the slots the preemptive action space indexes into (matches
+        ``sim.core.running_queue``)."""
+        return sorted(self.running_jobs(),
+                      key=lambda j: (-self.attained_service(j), j)
+                      )[:n_preempt]
 
     def in_system(self) -> int:
         return int(((self.status == PENDING) | (self.status == RUNNING)).sum())

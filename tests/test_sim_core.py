@@ -87,18 +87,19 @@ class TestQueueAndMask:
         np.testing.assert_array_equal(mask, [0, 0, 0, 0, 0, 0, 1])
 
 
-def run_pair(trace, n_nodes, gpus_per_node, actions, queue_len, n_placements=2):
+def run_pair(trace, n_nodes, gpus_per_node, actions, queue_len,
+             n_placements=2, preempt_len=0):
     """Drive oracle and JAX sim with the same action sequence; compare
     trajectories after every step."""
     params = C.SimParams(n_nodes=n_nodes, gpus_per_node=gpus_per_node,
                          max_jobs=trace.max_jobs, queue_len=queue_len,
-                         n_placements=n_placements)
+                         n_placements=n_placements, preempt_len=preempt_len)
     osim = O.OracleSim(trace, n_nodes, gpus_per_node)
     tr = C.Trace.from_array_trace(trace)
     jstate = C.init_state(params, tr)
     step = jax.jit(lambda s, a: C.rl_step(params, s, tr, a))
     for i, a in enumerate(actions):
-        oinfo = osim.rl_step(int(a), queue_len, n_placements)
+        oinfo = osim.rl_step(int(a), queue_len, n_placements, preempt_len)
         jstate, jinfo = step(jstate, jnp.int32(a))
         s = C.np_state(jstate)
         ctx = f"step {i} action {a}"
@@ -109,6 +110,8 @@ def run_pair(trace, n_nodes, gpus_per_node, actions, queue_len, n_placements=2):
         np.testing.assert_array_equal(s.alloc, osim.alloc, err_msg=ctx)
         np.testing.assert_array_equal(s.free, osim.free, err_msg=ctx)
         assert bool(jinfo.placed) == oinfo["placed"], ctx
+        assert bool(jinfo.preempted) == oinfo["preempted"], ctx
+        assert bool(jinfo.first_placed) == oinfo["first_placed"], ctx
         np.testing.assert_allclose(float(jinfo.dt), oinfo["dt"], atol=1e-3,
                                    err_msg=ctx)
         assert int(jinfo.in_system_before) == oinfo["in_system_before"], ctx
@@ -140,6 +143,71 @@ class TestRLStepEquivalence:
         np.testing.assert_allclose(float(stats["avg_jct"]), osim.avg_jct(),
                                    rtol=1e-5)
         assert int(stats["n_done"]) == 15
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_actions_with_preemption_match_oracle(self, seed):
+        """Bit-identical trajectories when the action space includes the
+        preempt block (VERDICT r1 missing #5). The overloaded trace keeps
+        many jobs running+pending so preempt actions actually fire."""
+        rng = np.random.default_rng(100 + seed)
+        trace = int_trace(rng, n_jobs=20, max_gpus=4, max_jobs=24)
+        queue_len, n_placements, preempt_len = 4, 2, 3
+        n_actions = queue_len * n_placements + preempt_len + 1
+        actions = rng.integers(0, n_actions, size=500)
+        run_pair(trace, n_nodes=3, gpus_per_node=2, actions=actions,
+                 queue_len=queue_len, preempt_len=preempt_len)
+
+    def test_running_queue_order_and_mask(self):
+        """Slot 0 = most attained GPU-service; preempt mask tracks slot
+        occupancy; preempting returns the job to the pending queue."""
+        trace = to_array_trace(
+            [JobRecord(0, 0.0, 50.0, 1), JobRecord(1, 0.0, 50.0, 2)],
+            max_jobs=4)
+        params = C.SimParams(1, 4, max_jobs=4, queue_len=2, n_placements=1,
+                             preempt_len=2)
+        tr = C.Trace.from_array_trace(trace)
+        state = C.init_state(params, tr)
+        state, _ = C.try_place(params, state, tr, jnp.int32(0), jnp.int32(0))
+        state, _ = C.try_place(params, state, tr, jnp.int32(1), jnp.int32(0))
+        state = C.advance_to(state, tr, jnp.float32(10.0))
+        # attained: job0 = 10·1 = 10, job1 = 10·2 = 20 → slot 0 is job 1
+        rq = np.asarray(C.running_queue(params, state, tr))
+        np.testing.assert_array_equal(rq, [1, 0])
+        mask = np.asarray(C.action_mask(params, state, tr))
+        # layout [K=2 slots][R=2 preempt][noop]: queue empty, both running
+        np.testing.assert_array_equal(mask, [0, 0, 1, 1, 1])
+        # preempt slot 0 → job 1 back to PENDING with service preserved
+        state, info = C.rl_step(params, state, tr,
+                                jnp.int32(params.queue_len))
+        assert bool(info.preempted) and not bool(info.placed)
+        assert float(info.dt) == 0.0
+        s = C.np_state(state)
+        assert s.status[1] == O.PENDING and s.remaining[1] == 40.0
+        assert s.free.sum() == 3
+
+    def test_replace_after_preempt_is_not_first(self):
+        """A preempt→re-place cycle must not farm place_bonus: the
+        re-placement reports first_placed=False (shaping potential
+        Φ = bonus·#{ever-started} never pays twice)."""
+        trace = to_array_trace([JobRecord(0, 0.0, 50.0, 2)], max_jobs=2)
+        params = C.SimParams(1, 2, max_jobs=2, queue_len=2, n_placements=1,
+                             preempt_len=1)
+        tr = C.Trace.from_array_trace(trace)
+        state = C.init_state(params, tr)
+        state, info = C.rl_step(params, state, tr, jnp.int32(0))  # place
+        assert bool(info.first_placed)
+        state, info = C.rl_step(params, state, tr, jnp.int32(2))  # preempt
+        assert bool(info.preempted)
+        state, info = C.rl_step(params, state, tr, jnp.int32(0))  # re-place
+        assert bool(info.placed) and not bool(info.first_placed)
+
+    def test_preempt_len_zero_mask_unchanged(self):
+        trace = to_array_trace([JobRecord(0, 0.0, 5.0, 1)], max_jobs=2)
+        params = C.SimParams(1, 2, max_jobs=2, queue_len=2, n_placements=1)
+        tr = C.Trace.from_array_trace(trace)
+        state = C.init_state(params, tr)
+        assert params.n_actions == 3
+        assert C.action_mask(params, state, tr).shape == (3,)
 
     def test_force_place_on_empty_event_horizon(self):
         # single job, agent always noops: the sim must force-place to
