@@ -45,6 +45,11 @@ class ExperimentConfig:
     a2c: A2CConfig = A2CConfig()
     iterations: int = 100
     seed: int = 0
+    # window streaming: every N iterations rotate every env onto the next
+    # windows of the source-trace tiling (and reset episodes), so a long
+    # run trains on the WHOLE trace instead of replaying the first
+    # n_envs windows forever. 0 = static windows (round-1 behavior).
+    resample_every: int = 0
 
     @property
     def total_gpus(self) -> int:

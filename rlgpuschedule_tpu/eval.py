@@ -12,6 +12,7 @@ baseline side replays the same windows through the oracle event-driven sim
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -24,6 +25,7 @@ from .env import hier as hier_lib
 from .env.env import EnvParams
 from .env.hier import HierParams
 from .sim import core
+from .sim.oracle import DONE as DONE_STATUS
 from .sim.schedulers import run_baseline
 from .traces.records import ArrayTrace
 
@@ -137,6 +139,130 @@ def replay(apply_fn: Callable, net_params: Any,
                       steps=state.t)
 
 
+def full_trace_replay(apply_fn: Callable, net_params: Any,
+                      env_params: EnvParams, source: ArrayTrace,
+                      max_steps_per_window: int | None = None,
+                      ) -> dict[str, Any]:
+    """Policy avg-JCT over an ENTIRE source trace via sequential windowed
+    replay with residual carry (VERDICT r1 missing #4) — one number
+    comparable to the ``native``/oracle baselines over the same trace
+    (SURVEY.md §3.4, north-star #2).
+
+    The trace streams through a fixed-shape job table of ``max_jobs``
+    rows: each window holds the carried residual jobs (anything not DONE
+    at the previous cutoff) plus as many fresh jobs as fit, and replays
+    under the greedy policy only up to the arrival time of the first
+    EXCLUDED job (the cutoff) — so a window never runs ahead of workload
+    it cannot see. Global time is the running sum of cutoffs, and JCT is
+    accounted against original submit times, so the stitched number is
+    exact up to two documented approximations:
+
+    - a job RUNNING at a cutoff is carried as PENDING with its remaining
+      service (checkpointed preemption — the sim's preemption model);
+    - when residuals alone fill the table (sustained overload) the window
+      runs to completion without contention from still-excluded arrivals.
+
+    The per-window program is jitted ONCE (fixed shapes) and reused for
+    every window.
+    """
+    sim = env_params.sim
+    J = sim.max_jobs
+    S = int(max_steps_per_window or 4 * J + 16)
+    # replay wants no horizon cut: only completion / cutoff freeze
+    rp = dataclasses.replace(env_params, horizon=S + 1)
+
+    @jax.jit
+    def _window(net_params, trace: core.Trace, cutoff):
+        state, ts = env_lib.reset(rp, trace)
+
+        def scan_step(carry, _):
+            state, obs, mask, frozen = carry
+            logits, _ = apply_fn(net_params, obs, mask)
+            action = _greedy_actions(logits)
+            new_state, new_ts = env_lib.step(rp, state, trace, action)
+            overshoot = new_state.sim.clock > cutoff
+            stop = frozen | overshoot
+            keep = lambda old, new: jax.tree.map(
+                lambda o, n: jnp.where(stop, o, n), old, new)
+            state = keep(state, new_state)
+            obs = keep(obs, new_ts.obs)
+            mask = keep(mask, new_ts.action_mask)
+            frozen = stop | new_ts.done
+            return (state, obs, mask, frozen), None
+
+        init = (state, ts.obs, ts.action_mask, jnp.bool_(False))
+        (state, _, _, _), _ = jax.lax.scan(scan_step, init, None, length=S)
+        return state
+
+    valid = np.flatnonzero(np.asarray(source.valid))
+    submit = np.asarray(source.submit, np.float64)[valid]
+    duration = np.asarray(source.duration, np.float64)[valid]
+    gpus = np.asarray(source.gpus, np.int32)[valid]
+    tenant = np.asarray(source.tenant, np.int32)[valid]
+    total = len(valid)
+    if total == 0:
+        raise ValueError("source trace has no valid jobs")
+    if int(gpus.max()) > sim.capacity:
+        raise ValueError("source demands exceed cluster capacity; clamp "
+                         "first (sim.core.validate_trace(clamp=True))")
+
+    finish_g = np.full(total, np.nan)       # global finish times
+    # residuals: original index -> remaining service
+    res_idx = np.zeros(0, np.int64)
+    res_rem = np.zeros(0, np.float64)
+    base, cursor, n_windows = 0.0, 0, 0
+    max_windows = 2 * total + 16   # ≥1 fresh job ingested per window
+    while cursor < total or len(res_idx):
+        n_windows += 1
+        if n_windows > max_windows:
+            raise RuntimeError(
+                f"full-trace replay made no progress after {n_windows} "
+                f"windows ({cursor}/{total} ingested, {len(res_idx)} "
+                f"residual)")
+        n_fresh = min(J - len(res_idx), total - cursor)
+        fresh = np.arange(cursor, cursor + n_fresh)
+        rows_idx = np.concatenate([res_idx, fresh])
+        rows_rem = np.concatenate([res_rem, duration[fresh]])
+        # rows must be submit-sorted (the sim's queue order contract); a
+        # carried not-yet-arrived residual can out-submit a fresh job
+        order = np.lexsort((rows_idx,
+                            np.maximum(submit[rows_idx] - base, 0.0)))
+        rows_idx, rows_rem = rows_idx[order], rows_rem[order]
+        n_rows = len(rows_idx)
+        cutoff = (submit[cursor + n_fresh] - base
+                  if cursor + n_fresh < total and n_fresh > 0 else np.inf)
+
+        w_submit = np.full(J, np.inf, np.float32)
+        w_duration = np.ones(J, np.float32)
+        w_gpus = np.zeros(J, np.int32)
+        w_tenant = np.zeros(J, np.int32)
+        w_valid = np.zeros(J, bool)
+        w_submit[:n_rows] = np.maximum(submit[rows_idx] - base, 0.0)
+        w_duration[:n_rows] = rows_rem
+        w_gpus[:n_rows] = gpus[rows_idx]
+        w_tenant[:n_rows] = tenant[rows_idx]
+        w_valid[:n_rows] = True
+        trace = core.Trace.from_array_trace(ArrayTrace(
+            w_submit, w_duration, w_gpus, w_tenant, w_valid))
+
+        state = _window(net_params, trace, jnp.float32(cutoff))
+        s = core.np_state(state.sim)
+        done_rows = w_valid & (s.status == DONE_STATUS)
+        finish_g[rows_idx[done_rows[:n_rows]]] = \
+            base + s.finish[:n_rows][done_rows[:n_rows]]
+        left = w_valid[:n_rows] & (s.status[:n_rows] != DONE_STATUS)
+        res_idx = rows_idx[left]
+        res_rem = np.asarray(s.remaining, np.float64)[:n_rows][left]
+        base = base + (cutoff if np.isfinite(cutoff) else float(s.clock))
+        cursor += n_fresh
+
+    jct = finish_g - submit
+    assert np.isfinite(jct).all()
+    return {"avg_jct": float(jct.mean()), "n_jobs": total,
+            "jct": jct, "finish": finish_g, "tenant": tenant,
+            "windows": n_windows, "makespan": float(np.nanmax(finish_g))}
+
+
 def pooled_avg_jct(result: EvalResult) -> tuple[float, float]:
     """Completion-weighted mean JCT across windows + completed fraction."""
     n = np.asarray(result.n_done, np.float64)
@@ -206,6 +332,35 @@ def jct_report(exp, windows: list[ArrayTrace] | None = None,
     report.update(baseline_jct_table(
         windows, exp.cfg.n_nodes, exp.cfg.gpus_per_node, baselines))
     if "tiresias" in report and report["tiresias"] > 0:
+        report["vs_tiresias"] = report["policy"] / report["tiresias"]
+    return report
+
+
+def full_trace_report(exp, max_jobs: int | None = None,
+                      baselines: tuple[str, ...] = ("fifo", "sjf", "srtf",
+                                                    "tiresias"),
+                      max_steps_per_window: int | None = None,
+                      ) -> dict[str, Any]:
+    """The FULL-trace comparison table (``evaluate --full-trace``): policy
+    avg-JCT via :func:`full_trace_replay` vs the baselines run by the
+    native C++ engine (oracle fallback) over the exact same source trace —
+    the apples-to-apples full-Philly comparison north-star #2 demands."""
+    if isinstance(exp.env_params, HierParams):
+        raise ValueError("full-trace evaluation supports flat configs; "
+                         "hierarchical pods replay per-window (jct_report)")
+    source = exp.source
+    if max_jobs is not None and source.num_jobs > max_jobs:
+        source = source.slice(0, max_jobs)
+    out = full_trace_replay(exp.apply_fn, exp.train_state.params,
+                            exp.env_params, source,
+                            max_steps_per_window=max_steps_per_window)
+    report: dict[str, Any] = {"policy": out["avg_jct"],
+                              "n_jobs": out["n_jobs"],
+                              "policy_windows": out["windows"]}
+    for name in baselines:
+        report[name] = run_baseline(source, exp.cfg.n_nodes,
+                                    exp.cfg.gpus_per_node, name).avg_jct()
+    if report.get("tiresias"):
         report["vs_tiresias"] = report["policy"] / report["tiresias"]
     return report
 

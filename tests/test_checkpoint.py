@@ -103,7 +103,32 @@ class TestExperimentResume:
 
             exp2 = Experiment.build(cfg)
             meta = exp2.restore_checkpoint(ck)
-        assert meta == {"iters": 2}
+        assert meta == {"iters": 2, "window_cursor": 0}
+        exp2.run(iterations=2)
+        final2 = jax.tree.map(np.asarray, exp2.train_state.params)
+        for a, b in zip(jax.tree.leaves(final), jax.tree.leaves(final2)):
+            assert np.allclose(a, b, atol=1e-6)
+
+    def test_streaming_resume_continues_identically(self, tmp_path):
+        """Same determinism contract with window streaming on: the restore
+        re-cuts the windows at the checkpointed cursor, so the resumed run
+        trains on the same rotating windows as the uninterrupted one."""
+        cfg = dataclasses.replace(
+            CONFIGS["ppo-mlp-synth64"], n_envs=2, window_jobs=16, horizon=64,
+            resample_every=1,
+            ppo=PPOConfig(n_steps=8, n_epochs=1, n_minibatches=2))
+        exp = Experiment.build(cfg)
+        exp.run(iterations=3)
+        assert exp.window_cursor > 0
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            exp.save_checkpoint(ck)
+            ck.wait()
+            exp.run(iterations=2)
+            final = jax.tree.map(np.asarray, exp.train_state.params)
+
+            exp2 = Experiment.build(cfg)
+            meta = exp2.restore_checkpoint(ck)
+        assert meta["window_cursor"] == exp2.window_cursor > 0
         exp2.run(iterations=2)
         final2 = jax.tree.map(np.asarray, exp2.train_state.params)
         for a, b in zip(jax.tree.leaves(final), jax.tree.leaves(final2)):

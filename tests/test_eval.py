@@ -91,3 +91,51 @@ class TestJctTable:
         report = eval_lib.jct_report(exp, include_random=False,
                                      baselines=("fifo",))
         assert "fifo" in report and "random" not in report
+
+
+class TestFullTraceReplay:
+    def test_single_window_matches_plain_replay(self):
+        """With max_jobs >= the whole trace, the stitched replay is one
+        window run to completion — its avg JCT must equal the plain frozen
+        replay of the same trace."""
+        cfg = dataclasses.replace(small_cfg(), window_jobs=40,
+                                  horizon=400)
+        exp = Experiment.build(cfg)
+        src = exp.source.slice(0, 40)
+        out = eval_lib.full_trace_replay(
+            exp.apply_fn, exp.train_state.params, exp.env_params, src)
+        assert out["windows"] == 1 and out["n_jobs"] == 40
+        traces = stack_traces([src], exp.env_params)
+        res = eval_lib.replay(exp.apply_fn, exp.train_state.params,
+                              exp.env_params, traces, max_steps=400)
+        assert int(res.n_done[0]) == 40
+        assert out["avg_jct"] == pytest.approx(float(res.avg_jct[0]),
+                                               rel=1e-5)
+
+    def test_residual_carry_covers_whole_trace(self):
+        """A window table much smaller than the trace forces residual
+        carry; every job must still finish, with sane JCT accounting."""
+        cfg = small_cfg()
+        exp = Experiment.build(cfg)
+        src = load_source_trace(cfg, n_jobs=150, seed=7)
+        src = validate_trace(exp.env_params.sim, src, clamp=True)
+        out = eval_lib.full_trace_replay(
+            exp.apply_fn, exp.train_state.params, exp.env_params, src)
+        assert out["n_jobs"] == 150
+        assert out["windows"] >= 150 // 12
+        assert np.isfinite(out["jct"]).all() and (out["jct"] >= 0).all()
+        # same trace through the native/oracle baselines: same order of
+        # magnitude (the untrained policy is bad, not absurd — forced
+        # placement keeps it live)
+        table = evaluate_baselines(src, cfg.n_nodes, cfg.gpus_per_node,
+                                   names=("fifo",))
+        assert out["avg_jct"] < 50 * table["fifo"]
+
+    def test_full_trace_report_table(self):
+        cfg = dataclasses.replace(small_cfg(), window_jobs=16)
+        exp = Experiment.build(cfg)
+        report = eval_lib.full_trace_report(exp, max_jobs=60)
+        for k in ("policy", "fifo", "sjf", "srtf", "tiresias",
+                  "vs_tiresias"):
+            assert k in report and np.isfinite(report[k])
+        assert report["n_jobs"] == 60

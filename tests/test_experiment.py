@@ -7,7 +7,9 @@ import dataclasses
 
 from rlgpuschedule_tpu.configs import CONFIGS, ExperimentConfig
 from rlgpuschedule_tpu.experiment import (Experiment, build_env_params,
-                                          load_source_trace, make_env_windows)
+                                          load_source_trace,
+                                          make_env_windows,
+                                          windows_per_pass)
 from rlgpuschedule_tpu.algos import PPOConfig, A2CConfig
 
 
@@ -39,6 +41,36 @@ class TestConfigs:
         for w in wins:
             assert w.num_jobs == cfg.window_jobs
             assert w.submit[0] == 0.0
+
+    def test_window_tiling_covers_every_source_job(self):
+        """Advancing the cursor by n_envs per resample must sweep the
+        whole trace (VERDICT r1 missing #3)."""
+        cfg = small(CONFIGS["ppo-mlp-synth64"])
+        src = load_source_trace(cfg, n_jobs=100)  # not a multiple of 16
+        per_pass = windows_per_pass(100, cfg.window_jobs)
+        seen = set()
+        for start in range(0, per_pass, cfg.n_envs):
+            for w in make_env_windows(cfg, src, start):
+                # recover source rows by (duration, gpus) fingerprint
+                for j in range(w.max_jobs):
+                    if w.valid[j]:
+                        hits = np.flatnonzero(
+                            (src.duration == w.duration[j])
+                            & (src.gpus == w.gpus[j]))
+                        seen.update(hits.tolist())
+        assert len(seen) == 100
+
+
+class TestWindowStreaming:
+    def test_resample_rotates_windows_without_recompile(self):
+        cfg = small(CONFIGS["ppo-mlp-synth64"], resample_every=1)
+        exp = Experiment.build(cfg)
+        first = np.asarray(exp.traces.duration).copy()
+        out = exp.run(iterations=3, log_every=1)
+        assert out["window_cursor"] == 2 * cfg.n_envs  # 2 resamples fired
+        assert not np.array_equal(first, np.asarray(exp.traces.duration))
+        assert all(np.isfinite(list(h.values())).all()
+                   for h in out["history"])
 
 
 class TestExperimentRuns:
