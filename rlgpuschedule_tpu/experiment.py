@@ -44,6 +44,16 @@ def load_source_trace(cfg: ExperimentConfig, n_jobs: int | None = None,
         return gen_poisson_trace(cfg.arrival_rate, n, seed,
                                  mean_duration=cfg.mean_duration,
                                  n_tenants=max(cfg.n_tenants, 1))
+    if cfg.trace in ("philly-proxy", "pai-proxy"):
+        from .traces import gen_pai_proxy_trace, gen_philly_proxy_trace
+        n = n_jobs or max(cfg.window_jobs * max(cfg.n_envs, 8), 4096)
+        gen = (gen_philly_proxy_trace if cfg.trace == "philly-proxy"
+               else gen_pai_proxy_trace)
+        kw = {}
+        if cfg.n_tenants:       # keep tenant ids inside the env's bins
+            kw["n_tenants"] = cfg.n_tenants
+        return gen(n, seed, n_gpus=cfg.total_gpus, load=cfg.trace_load,
+                   max_gang=cfg.total_gpus, **kw)
     if cfg.trace_path is None:
         raise ValueError(
             f"config {cfg.name!r} uses trace={cfg.trace!r} but has no "

@@ -4,10 +4,14 @@ from .records import (JobRecord, ArrayTrace, to_array_trace, from_array_trace,
 from .synthetic import gen_poisson_jobs, gen_poisson_trace
 from .philly import load_philly, load_philly_jobs
 from .pai import load_pai, load_pai_jobs
+from .philly_proxy import (gen_philly_proxy_jobs, gen_philly_proxy_trace,
+                           gen_pai_proxy_jobs, gen_pai_proxy_trace)
 
 __all__ = [
     "JobRecord", "ArrayTrace", "to_array_trace", "from_array_trace",
     "STATUS_PASS", "STATUS_KILLED", "STATUS_FAILED",
     "gen_poisson_jobs", "gen_poisson_trace",
     "load_philly", "load_philly_jobs", "load_pai", "load_pai_jobs",
+    "gen_philly_proxy_jobs", "gen_philly_proxy_trace",
+    "gen_pai_proxy_jobs", "gen_pai_proxy_trace",
 ]

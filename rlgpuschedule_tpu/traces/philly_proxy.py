@@ -1,0 +1,191 @@
+"""Philly-statistics proxy trace generator (L0).
+
+Capability parity: SURVEY.md §2 "Philly trace loader" / §7 hard part (b)
+"faithful Philly-trace semantics". The real Microsoft Philly CSV cannot
+exist on this machine (no network — SURVEY.md top caveat), so config 2's
+512-GPU runs need a *reproducible stand-in with the published Philly
+workload statistics* (VERDICT r2 missing #3 / next-round #3). This
+generator is seeded and matches the distributions reported in Jeon et al.,
+"Analysis of Large-Scale Multi-Tenant GPU Clusters for DNN Training
+Workloads" (USENIX ATC'19), the paper the Philly trace release accompanies:
+
+- **Gang sizes**: single-GPU jobs dominate by count; demand is power-of-two
+  up to 128 GPUs with a thin large-job tail.
+- **Durations**: heavy-tailed — minutes-scale median, hours-scale mean,
+  multi-day maximum (log-normal body, sigma ~2).
+- **Terminal status mix**: roughly 2/3 passed, ~1/4 killed, ~1/9 failed;
+  failed jobs die early (short durations), killed jobs skew long — and
+  unsuccessful jobs still occupy their GPUs for their whole runtime, which
+  is why they must stay in the trace (records.py STATUS_* note).
+- **Arrivals**: Poisson modulated by a diurnal cycle (busy day, quiet
+  night) — not a flat rate.
+- **Tenants**: ~14 virtual clusters with a skewed (Zipf-like) job share.
+
+Rather than fixing an arrival rate, the generator targets an *offered
+load* (requested GPU-seconds per wall-second / cluster GPUs) so the same
+statistics stress a 512-GPU simulated cluster (config 2) the way the real
+trace stressed Philly's ~2.5k GPUs. Philly ran hot (queueing was the
+norm), so the default load is 1.1 — slightly oversubscribed, which is the
+regime where scheduling policy matters.
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import numpy as np
+
+from .records import (ArrayTrace, JobRecord, STATUS_FAILED, STATUS_KILLED,
+                      STATUS_PASS, to_array_trace)
+
+# Gang-size mix by job count (power-of-two, 1-GPU heavy, thin 128 tail).
+PHILLY_GPU_SIZES = (1, 2, 4, 8, 16, 32, 64, 128)
+PHILLY_GPU_PROBS = (0.70, 0.09, 0.08, 0.08, 0.03, 0.013, 0.005, 0.002)
+
+# Terminal-status mix by job count.
+PHILLY_STATUS = (STATUS_PASS, STATUS_KILLED, STATUS_FAILED)
+PHILLY_STATUS_PROBS = (0.66, 0.22, 0.12)
+# Duration multiplier per status: failed jobs fail early; killed jobs are
+# the long-runners users eventually give up on.
+_STATUS_DUR_MULT = {STATUS_PASS: 1.0, STATUS_KILLED: 2.0, STATUS_FAILED: 0.25}
+
+# Log-normal duration body: median ~12 min, sigma 1.9 => mean ~ 4.4 h,
+# heavy tail clamped to 30 days; floor 30 s.
+PHILLY_MEDIAN_DURATION_S = 720.0
+PHILLY_DURATION_SIGMA = 1.9
+MIN_DURATION_S = 30.0
+MAX_DURATION_S = 30 * 86400.0
+
+N_VIRTUAL_CLUSTERS = 14
+DIURNAL_AMPLITUDE = 0.5          # rate swings +-50% over a 24h cycle
+_DAY_S = 86400.0
+
+
+def _mean_gpus(sizes: Sequence[int], probs: Sequence[float]) -> float:
+    return float(np.dot(sizes, np.asarray(probs) / np.sum(probs)))
+
+
+def base_arrival_rate(n_gpus: int, load: float,
+                      gpu_sizes: Sequence[int] = PHILLY_GPU_SIZES,
+                      gpu_probs: Sequence[float] = PHILLY_GPU_PROBS,
+                      median_duration: float = PHILLY_MEDIAN_DURATION_S,
+                      sigma: float = PHILLY_DURATION_SIGMA) -> float:
+    """Jobs/sec such that offered load (requested GPU-seconds per second /
+    n_gpus) equals ``load``: rate = load * n_gpus / E[gpus * duration]
+    (gang size and duration are drawn independently). The duration mean is
+    the analytic status-mixed log-normal mean; the 30-day clamp's effect
+    (well under 2% of mass) is ignored."""
+    body_mean = math.exp(math.log(median_duration) + 0.5 * sigma ** 2)
+    mean_dur = body_mean * sum(p * _STATUS_DUR_MULT[s] for s, p in
+                               zip(PHILLY_STATUS, PHILLY_STATUS_PROBS))
+    return load * n_gpus / (_mean_gpus(gpu_sizes, gpu_probs) * mean_dur)
+
+
+def _diurnal_arrivals(rate: float, n_jobs: int,
+                      rng: np.random.Generator) -> np.ndarray:
+    """Non-homogeneous Poisson arrivals at mean rate ``rate`` with a
+    sinusoidal diurnal cycle, by thinning: candidates at the peak rate
+    ``rate * (1 + A)``, each kept with probability rate(t)/peak."""
+    peak = rate * (1.0 + DIURNAL_AMPLITUDE)
+    out = np.empty(0, np.float64)
+    t = 0.0
+    while out.size < n_jobs:
+        need = n_jobs - out.size
+        # oversample so one round usually suffices
+        n_cand = int(need * (1.0 + DIURNAL_AMPLITUDE) * 1.2) + 16
+        cand = t + np.cumsum(rng.exponential(1.0 / peak, size=n_cand))
+        t = float(cand[-1])
+        accept = rate * (1.0 + DIURNAL_AMPLITUDE
+                         * np.sin(2.0 * np.pi * cand / _DAY_S)) / peak
+        out = np.concatenate([out, cand[rng.random(n_cand) < accept]])
+    return out[:n_jobs]
+
+
+def gen_philly_proxy_jobs(
+    n_jobs: int,
+    seed: int,
+    n_gpus: int = 512,
+    load: float = 1.1,
+    max_gang: int | None = None,
+    n_tenants: int = N_VIRTUAL_CLUSTERS,
+    gpu_sizes: Sequence[int] = PHILLY_GPU_SIZES,
+    gpu_probs: Sequence[float] = PHILLY_GPU_PROBS,
+    median_duration: float = PHILLY_MEDIAN_DURATION_S,
+    sigma: float = PHILLY_DURATION_SIGMA,
+) -> list[JobRecord]:
+    """``n_jobs`` seeded jobs with Philly-statistics marginals, offered at
+    ``load``× the capacity of an ``n_gpus`` cluster. ``max_gang`` drops
+    gang sizes above the cluster's reach (e.g. 8 for a single
+    8-GPU-per-node pod with pack-only placement) by renormalizing the size
+    mix — demand clamping at upload would otherwise distort the mix."""
+    if n_jobs <= 0:
+        raise ValueError("n_jobs must be positive")
+    rng = np.random.default_rng(seed)
+
+    sizes = np.asarray(gpu_sizes, np.int64)
+    probs = np.asarray(gpu_probs, np.float64)
+    if max_gang is not None:
+        keep = sizes <= max_gang
+        if not keep.any():
+            raise ValueError(f"max_gang={max_gang} below smallest gang size")
+        sizes, probs = sizes[keep], probs[keep]
+    probs = probs / probs.sum()
+
+    rate = base_arrival_rate(n_gpus, load, sizes, probs, median_duration,
+                             sigma)
+    submit = _diurnal_arrivals(rate, n_jobs, rng)
+    submit -= submit[0]          # first job at t=0, matching gen_poisson_jobs
+
+    gpus = rng.choice(sizes, size=n_jobs, p=probs)
+    status = rng.choice(np.asarray(PHILLY_STATUS, np.int64), size=n_jobs,
+                        p=np.asarray(PHILLY_STATUS_PROBS))
+    mult = np.asarray([_STATUS_DUR_MULT[s] for s in PHILLY_STATUS])[status]
+    dur = rng.lognormal(math.log(median_duration), sigma, size=n_jobs) * mult
+    dur = np.clip(dur, MIN_DURATION_S, MAX_DURATION_S)
+
+    # Zipf-skewed virtual-cluster share (tenant 0 busiest), like Philly's
+    # uneven 14 VCs.
+    ranks = np.arange(1, n_tenants + 1, dtype=np.float64)
+    tenant_probs = (1.0 / ranks) / np.sum(1.0 / ranks)
+    tenant = rng.choice(n_tenants, size=n_jobs, p=tenant_probs)
+
+    return [JobRecord(i, float(submit[i]), float(dur[i]), int(gpus[i]),
+                      int(tenant[i]), int(status[i]))
+            for i in range(n_jobs)]
+
+
+def gen_philly_proxy_trace(n_jobs: int, seed: int,
+                           max_jobs: int | None = None,
+                           **kw) -> ArrayTrace:
+    return to_array_trace(gen_philly_proxy_jobs(n_jobs, seed, **kw),
+                          max_jobs=max_jobs)
+
+
+# ---- Alibaba-PAI-statistics preset ------------------------------------------
+# Config 3's multi-tenant fairness runs need the same no-CSV stand-in for
+# the PAI trace (Weng et al., "MLaaS in the Wild", NSDI'22): tasks are much
+# smaller than Philly's (1-GPU dominates even harder, gangs rarely exceed
+# 8), durations shorter (minutes-scale median), and tenancy is the point —
+# many users sharing one cluster.
+
+PAI_GPU_SIZES = (1, 2, 4, 8)
+PAI_GPU_PROBS = (0.81, 0.10, 0.06, 0.03)
+PAI_MEDIAN_DURATION_S = 300.0
+PAI_DURATION_SIGMA = 1.6
+PAI_N_TENANTS = 24
+
+
+def gen_pai_proxy_jobs(n_jobs: int, seed: int, n_gpus: int = 128,
+                       load: float = 1.1, max_gang: int | None = None,
+                       n_tenants: int = PAI_N_TENANTS) -> list[JobRecord]:
+    return gen_philly_proxy_jobs(
+        n_jobs, seed, n_gpus=n_gpus, load=load, max_gang=max_gang,
+        n_tenants=n_tenants, gpu_sizes=PAI_GPU_SIZES,
+        gpu_probs=PAI_GPU_PROBS, median_duration=PAI_MEDIAN_DURATION_S,
+        sigma=PAI_DURATION_SIGMA)
+
+
+def gen_pai_proxy_trace(n_jobs: int, seed: int, max_jobs: int | None = None,
+                        **kw) -> ArrayTrace:
+    return to_array_trace(gen_pai_proxy_jobs(n_jobs, seed, **kw),
+                          max_jobs=max_jobs)

@@ -10,8 +10,8 @@ Examples::
 
     python -m rlgpuschedule_tpu.train --config ppo-mlp-synth64
     python -m rlgpuschedule_tpu.train --config ppo-cnn-philly512 \
-        --trace-path philly.csv --iterations 200 --ckpt-dir out/ckpt \
-        --log-csv out/metrics.csv --log-every 10 --report
+        --trace philly --trace-path philly.csv --iterations 200 \
+        --ckpt-dir out/ckpt --log-csv out/metrics.csv --log-every 10 --report
     python -m rlgpuschedule_tpu.train --config hier-pbt-member \
         --pbt --n-pop 4 --pbt-ready 10            # config 5: PBT population
 """
@@ -40,8 +40,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gpus-per-node", type=int, default=None)
     p.add_argument("--window-jobs", type=int, default=None)
     p.add_argument("--horizon", type=int, default=None)
+    p.add_argument("--trace", default=None,
+                   choices=["synthetic", "philly", "pai", "philly-proxy",
+                            "pai-proxy"],
+                   help="trace source (e.g. switch a -proxy preset to the "
+                        "real CSV loader)")
     p.add_argument("--trace-path", default=None,
                    help="CSV path for philly/pai traces")
+    p.add_argument("--trace-load", type=float, default=None,
+                   help="proxy traces: offered-load target (default 1.1)")
     p.add_argument("--resample-every", type=int, default=None,
                    help="window streaming: rotate env windows over the "
                         "source trace every N iterations (0 = static)")
@@ -72,7 +79,8 @@ def apply_overrides(cfg: ExperimentConfig,
               "n_envs": args.n_envs, "n_nodes": args.n_nodes,
               "gpus_per_node": args.gpus_per_node,
               "window_jobs": args.window_jobs, "horizon": args.horizon,
-              "trace_path": args.trace_path,
+              "trace": args.trace, "trace_path": args.trace_path,
+              "trace_load": args.trace_load,
               "resample_every": args.resample_every}
     return dataclasses.replace(
         cfg, **{k: v for k, v in fields.items() if v is not None})

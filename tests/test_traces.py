@@ -6,6 +6,7 @@ import pytest
 
 from rlgpuschedule_tpu.traces import (
     ArrayTrace, JobRecord, STATUS_FAILED, STATUS_KILLED, STATUS_PASS,
+    gen_pai_proxy_jobs, gen_philly_proxy_jobs, gen_philly_proxy_trace,
     gen_poisson_jobs, gen_poisson_trace, load_pai_jobs, load_philly_jobs,
     to_array_trace, from_array_trace,
 )
@@ -84,6 +85,88 @@ class TestPhilly:
     def test_max_jobs(self):
         jobs = load_philly_jobs(os.path.join(FIXTURES, "philly_small.csv"), max_jobs=2)
         assert len(jobs) == 2
+
+
+class TestPhillyProxy:
+    """traces/philly_proxy.py — the published-statistics stand-in that lets
+    configs 2/3 run at scale with no external CSV (VERDICT r2 missing #3)."""
+
+    def test_deterministic(self):
+        a = gen_philly_proxy_jobs(200, seed=3)
+        b = gen_philly_proxy_jobs(200, seed=3)
+        assert a == b
+        assert a != gen_philly_proxy_jobs(200, seed=4)
+
+    def test_philly_marginals(self):
+        jobs = gen_philly_proxy_jobs(20_000, seed=0, n_gpus=512, load=1.1)
+        gpus = np.array([j.gpus for j in jobs])
+        durs = np.array([j.duration for j in jobs])
+        status = np.array([j.status for j in jobs])
+        # gang mix: 1-GPU dominates, power-of-two only, thin 128 tail
+        assert set(np.unique(gpus)) <= {1, 2, 4, 8, 16, 32, 64, 128}
+        frac1 = (gpus == 1).mean()
+        assert 0.65 < frac1 < 0.75
+        assert 0 < (gpus >= 64).mean() < 0.02
+        # durations heavy-tailed: minutes median, hours mean
+        assert 300 < np.median(durs) < 2000
+        assert np.mean(durs) > 5 * np.median(durs)
+        assert durs.min() >= 30.0 and durs.max() <= 30 * 86400.0
+        # status mix ~ 2/3 passed; failed jobs die early, killed run long
+        assert 0.60 < (status == STATUS_PASS).mean() < 0.72
+        assert 0.16 < (status == STATUS_KILLED).mean() < 0.28
+        assert 0.08 < (status == STATUS_FAILED).mean() < 0.16
+        med_f = np.median(durs[status == STATUS_FAILED])
+        med_k = np.median(durs[status == STATUS_KILLED])
+        assert med_f < np.median(durs) < med_k
+
+    def test_offered_load_targets_cluster(self):
+        n_gpus, load = 256, 1.0
+        jobs = gen_philly_proxy_jobs(30_000, seed=1, n_gpus=n_gpus, load=load)
+        span = jobs[-1].submit - jobs[0].submit
+        gpu_seconds = sum(j.gpus * j.duration for j in jobs)
+        measured = gpu_seconds / (span * n_gpus)
+        assert abs(measured - load) / load < 0.15
+
+    def test_diurnal_cycle_present(self):
+        # arrival counts binned by hour-of-day must swing with the sinusoid
+        jobs = gen_philly_proxy_jobs(50_000, seed=2, n_gpus=2048)
+        hours = (np.array([j.submit for j in jobs]) % 86400.0) // 3600
+        counts = np.bincount(hours.astype(int), minlength=24)
+        assert counts.max() > 1.5 * counts.min()
+
+    def test_max_gang_renormalizes(self):
+        jobs = gen_philly_proxy_jobs(2000, seed=5, n_gpus=64, max_gang=8)
+        assert max(j.gpus for j in jobs) <= 8
+        # 1-GPU share grows once the big sizes are dropped
+        assert np.mean([j.gpus == 1 for j in jobs]) > 0.7
+
+    def test_tenants_skewed(self):
+        jobs = gen_philly_proxy_jobs(10_000, seed=6)
+        tenants = np.array([j.tenant for j in jobs])
+        assert tenants.max() < 14 and tenants.min() >= 0
+        counts = np.bincount(tenants, minlength=14)
+        assert counts[0] > 3 * counts[13]  # Zipf head vs tail
+
+    def test_pai_preset_smaller_jobs(self):
+        pai = gen_pai_proxy_jobs(5000, seed=0, n_gpus=128)
+        assert max(j.gpus for j in pai) <= 8
+        assert np.mean([j.gpus == 1 for j in pai]) > 0.75
+        assert np.median([j.duration for j in pai]) < 1000
+        assert max(j.tenant for j in pai) < 24
+
+    def test_array_trace_form(self):
+        tr = gen_philly_proxy_trace(100, seed=7, max_jobs=128)
+        assert isinstance(tr, ArrayTrace)
+        assert tr.num_jobs == 100 and tr.max_jobs == 128
+        s = tr.submit[tr.valid]
+        assert s[0] == 0.0 and np.all(np.diff(s) >= 0)
+
+    def test_100k_scale_fast(self):
+        import time
+        t0 = time.perf_counter()
+        jobs = gen_philly_proxy_jobs(100_000, seed=9)
+        assert len(jobs) == 100_000
+        assert time.perf_counter() - t0 < 30.0
 
 
 class TestPAI:
