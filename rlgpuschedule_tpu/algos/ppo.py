@@ -3,10 +3,13 @@
 Capability parity: SURVEY.md §2 "PPO trainer" and §3.1 — the reference's
 rollout→GAE→minibatch-update iteration, lowered end-to-end to XLA: the
 whole train step (fused rollout scan + GAE reverse scan + epoch×minibatch
-update scans) is ONE jitted function. Gradient sync for data parallelism is
-a ``lax.pmean`` over the mesh axis (``axis_name``), the TPU-native
-replacement for the reference's NCCL allreduce (SURVEY.md §2 "Distributed
-comm backend"; used under ``shard_map`` in ``parallel.dp``).
+update scans) is ONE jitted function. Data-parallel gradient sync — the
+TPU-native replacement for the reference's NCCL allreduce (SURVEY.md §2
+"Distributed comm backend") — has two assemblies in ``parallel.dp``:
+``shard_train`` jits the ``axis_name=None`` step with GSPMD shardings
+(XLA inserts the psum), and ``shard_map_train`` wraps an
+``axis_name=DATA_AXIS`` step in ``shard_map`` so the ``lax.pmean`` calls
+below bind to the mesh axis explicitly.
 """
 from __future__ import annotations
 

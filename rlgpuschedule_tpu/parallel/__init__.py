@@ -2,7 +2,8 @@
 population-based training (PBT)."""
 from .mesh import (make_mesh, replicated, env_sharded, pop_sharded,
                    pop_env_sharded, DATA_AXIS, POP_AXIS)
-from .dp import shard_train, carry_sharding_prefix, put_carry
+from .dp import (shard_train, shard_map_train, carry_sharding_prefix,
+                 put_carry)
 from .population import (HParams, MemberState, init_member,
                          make_member_step, make_population_step,
                          jit_population_step, population_shardings,
@@ -13,7 +14,7 @@ from .pbt import (PBTConfig, PBTController, PBTDecision, exploit_explore,
 __all__ = [
     "make_mesh", "replicated", "env_sharded", "pop_sharded",
     "pop_env_sharded", "DATA_AXIS", "POP_AXIS",
-    "shard_train", "carry_sharding_prefix", "put_carry",
+    "shard_train", "shard_map_train", "carry_sharding_prefix", "put_carry",
     "HParams", "MemberState", "init_member", "make_member_step",
     "make_population_step", "jit_population_step", "population_shardings",
     "sample_hparams", "stack_members",

