@@ -142,7 +142,8 @@ def replay(apply_fn: Callable, net_params: Any,
 def full_trace_replay(apply_fn: Callable, net_params: Any,
                       env_params: EnvParams, source: ArrayTrace,
                       max_steps_per_window: int | None = None,
-                      ) -> dict[str, Any]:
+                      policy: str = "greedy",
+                      key: jax.Array | None = None) -> dict[str, Any]:
     """Policy avg-JCT over an ENTIRE source trace via sequential windowed
     replay with residual carry (VERDICT r1 missing #4) — one number
     comparable to the ``native``/oracle baselines over the same trace
@@ -151,20 +152,37 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
     The trace streams through a fixed-shape job table of ``max_jobs``
     rows: each window holds the carried residual jobs (anything not DONE
     at the previous cutoff) plus as many fresh jobs as fit, and replays
-    under the greedy policy only up to the arrival time of the first
-    EXCLUDED job (the cutoff) — so a window never runs ahead of workload
-    it cannot see. Global time is the running sum of cutoffs, and JCT is
-    accounted against original submit times, so the stitched number is
-    exact up to two documented approximations:
+    under the policy only up to the arrival time of the first EXCLUDED
+    job (the cutoff) — so a window never runs ahead of workload it cannot
+    see. When the first excluded job has ALREADY arrived (deep backlog:
+    global time has outrun the arrival process, so the cutoff is in the
+    past), the window instead runs just until it completes one job —
+    freeing a table row — and global time advances by the sim time
+    actually consumed. Global time is the running sum of those advances,
+    and JCT is accounted against original submit times. (Round-3 fix: the
+    pre-fix code let the already-arrived cutoff go NEGATIVE, moving
+    global time backward and silently deleting queueing delay — stitched
+    avg JCT stayed flat as the backlog grew while every true-sim baseline
+    grew linearly. tests/test_eval.py pins windowed-FIFO ≈ oracle FIFO on
+    an overloaded trace.)
 
-    - a job RUNNING at a cutoff is carried as PENDING with its remaining
-      service (checkpointed preemption — the sim's preemption model);
-    - when residuals alone fill the table (sustained overload) the window
-      runs to completion without contention from still-excluded arrivals.
+    The stitched number is exact up to two documented approximations:
+
+    - a job RUNNING at a window boundary is carried as PENDING with its
+      remaining service (checkpointed preemption — the sim's preemption
+      model);
+    - a future cutoff freezes the window at the last decision point not
+      beyond it, so service between that point and the cutoff is re-run
+      next window (conservative: never undercounts JCT).
 
     The per-window program is jitted ONCE (fixed shapes) and reused for
     every window.
     """
+    if policy not in ("greedy", "random"):
+        raise ValueError(f"unknown replay policy {policy!r}; "
+                         f"expected 'greedy' or 'random'")
+    if key is None:
+        key = jax.random.PRNGKey(0)
     sim = env_params.sim
     J = sim.max_jobs
     S = int(max_steps_per_window or 4 * J + 16)
@@ -172,16 +190,32 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
     rp = dataclasses.replace(env_params, horizon=S + 1)
 
     @jax.jit
-    def _window(net_params, trace: core.Trace, cutoff):
+    def _window(net_params, trace: core.Trace, cutoff, need_completion,
+                wkey):
+        """One window replay. ``cutoff``: local freeze time (+inf = run to
+        completion). ``need_completion`` (deep-backlog mode): ignore the
+        clock until one valid job completes, then freeze — the step that
+        completes is KEPT (its clock is the window's true span), unlike
+        the future-cutoff mode where the overshooting step is discarded."""
         state, ts = env_lib.reset(rp, trace)
 
-        def scan_step(carry, _):
+        def scan_step(carry, k):
             state, obs, mask, frozen = carry
-            logits, _ = apply_fn(net_params, obs, mask)
-            action = _greedy_actions(logits)
+            if policy == "random":
+                # masked-uniform; _random_actions expects a batch axis
+                action = jax.tree.map(
+                    lambda a: a[0],
+                    _random_actions(k, jax.tree.map(lambda m: m[None], mask)))
+            else:
+                logits, _ = apply_fn(net_params, obs, mask)
+                action = _greedy_actions(logits)
             new_state, new_ts = env_lib.step(rp, state, trace, action)
-            overshoot = new_state.sim.clock > cutoff
-            stop = frozen | overshoot
+            done_before = jnp.sum(
+                (state.sim.status == DONE_STATUS) & trace.valid)
+            # future cutoff: discard any step past it. already-arrived
+            # cutoff: run freely until a completion exists, then freeze
+            gate = jnp.where(need_completion, done_before >= 1, True)
+            stop = frozen | ((new_state.sim.clock > cutoff) & gate)
             keep = lambda old, new: jax.tree.map(
                 lambda o, n: jnp.where(stop, o, n), old, new)
             state = keep(state, new_state)
@@ -191,8 +225,19 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
             return (state, obs, mask, frozen), None
 
         init = (state, ts.obs, ts.action_mask, jnp.bool_(False))
-        (state, _, _, _), _ = jax.lax.scan(scan_step, init, None, length=S)
-        return state
+        (state, _, _, _), _ = jax.lax.scan(scan_step, init,
+                                           jax.random.split(wkey, S))
+        # future-cutoff freeze keeps the last decision point NOT beyond the
+        # cutoff; between that clock and the cutoff there are no events (the
+        # next one overshot), only continuous service — advance it, or
+        # running jobs lose (cutoff − clock) of work at EVERY window seam
+        # (measured ~2× JCT over-count on an overloaded 2k-job trace)
+        t_end = jnp.minimum(cutoff, core.next_event_time(state.sim, trace))
+        t_end = jnp.maximum(t_end, state.sim.clock)
+        sim = core.advance_to(
+            state.sim, trace,
+            jnp.where(jnp.isfinite(t_end), t_end, state.sim.clock))
+        return state._replace(sim=sim)
 
     valid = np.flatnonzero(np.asarray(source.valid))
     submit = np.asarray(source.submit, np.float64)[valid]
@@ -230,7 +275,13 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
         rows_idx, rows_rem = rows_idx[order], rows_rem[order]
         n_rows = len(rows_idx)
         cutoff = (submit[cursor + n_fresh] - base
-                  if cursor + n_fresh < total and n_fresh > 0 else np.inf)
+                  if cursor + n_fresh < total else np.inf)
+        # deep backlog: the first excluded job has already arrived (global
+        # time outran the arrival process) — run only until one completion
+        # frees a row, so the waiting job is ingested ASAP
+        need_completion = bool(np.isfinite(cutoff) and cutoff <= 0.0)
+        if need_completion:
+            cutoff = 0.0
 
         w_submit = np.full(J, np.inf, np.float32)
         w_duration = np.ones(J, np.float32)
@@ -245,7 +296,9 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
         trace = core.Trace.from_array_trace(ArrayTrace(
             w_submit, w_duration, w_gpus, w_tenant, w_valid))
 
-        state = _window(net_params, trace, jnp.float32(cutoff))
+        key, wkey = jax.random.split(key)
+        state = _window(net_params, trace, jnp.float32(cutoff),
+                        jnp.bool_(need_completion), wkey)
         s = core.np_state(state.sim)
         done_rows = w_valid & (s.status == DONE_STATUS)
         finish_g[rows_idx[done_rows[:n_rows]]] = \
@@ -253,7 +306,10 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
         left = w_valid[:n_rows] & (s.status[:n_rows] != DONE_STATUS)
         res_idx = rows_idx[left]
         res_rem = np.asarray(s.remaining, np.float64)[:n_rows][left]
-        base = base + (cutoff if np.isfinite(cutoff) else float(s.clock))
+        # future cutoff: global time jumps to the excluded arrival.
+        # completion mode / final drain: advance by sim time consumed
+        base = base + (cutoff if np.isfinite(cutoff) and not need_completion
+                       else float(s.clock))
         cursor += n_fresh
 
     jct = finish_g - submit
@@ -340,11 +396,14 @@ def full_trace_report(exp, max_jobs: int | None = None,
                       baselines: tuple[str, ...] = ("fifo", "sjf", "srtf",
                                                     "tiresias"),
                       max_steps_per_window: int | None = None,
-                      ) -> dict[str, Any]:
+                      include_random: bool = True) -> dict[str, Any]:
     """The FULL-trace comparison table (``evaluate --full-trace``): policy
     avg-JCT via :func:`full_trace_replay` vs the baselines run by the
     native C++ engine (oracle fallback) over the exact same source trace —
-    the apples-to-apples full-Philly comparison north-star #2 demands."""
+    the apples-to-apples full-Philly comparison north-star #2 demands.
+    ``include_random`` adds a masked-uniform-policy row through the same
+    windowed-replay machinery (the learning-smoke yardstick: the trained
+    policy must decisively beat it)."""
     if isinstance(exp.env_params, HierParams):
         raise ValueError("full-trace evaluation supports flat configs; "
                          "hierarchical pods replay per-window (jct_report)")
@@ -357,6 +416,12 @@ def full_trace_report(exp, max_jobs: int | None = None,
     report: dict[str, Any] = {"policy": out["avg_jct"],
                               "n_jobs": out["n_jobs"],
                               "policy_windows": out["windows"]}
+    if include_random:
+        rnd = full_trace_replay(exp.apply_fn, exp.train_state.params,
+                                exp.env_params, source,
+                                max_steps_per_window=max_steps_per_window,
+                                policy="random", key=jax.random.PRNGKey(1))
+        report["random"] = rnd["avg_jct"]
     for name in baselines:
         report[name] = run_baseline(source, exp.cfg.n_nodes,
                                     exp.cfg.gpus_per_node, name).avg_jct()

@@ -35,6 +35,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-nodes", type=int, default=None)
     p.add_argument("--gpus-per-node", type=int, default=None)
     p.add_argument("--window-jobs", type=int, default=None)
+    p.add_argument("--queue-len", type=int, default=None)
     p.add_argument("--horizon", type=int, default=None)
     p.add_argument("--ckpt-dir", default=None,
                    help="restore the trained policy from this checkpoint "
@@ -64,7 +65,7 @@ def main(argv: list[str] | None = None) -> dict:
             {"trace_path": args.trace_path, "seed": args.seed,
              "n_envs": args.n_envs, "n_nodes": args.n_nodes,
              "gpus_per_node": args.gpus_per_node,
-             "window_jobs": args.window_jobs,
+             "window_jobs": args.window_jobs, "queue_len": args.queue_len,
              "horizon": args.horizon}.items() if v is not None}
     cfg = dataclasses.replace(cfg, **over)
 
@@ -90,7 +91,8 @@ def main(argv: list[str] | None = None) -> dict:
         print("note: no --ckpt-dir; evaluating untrained init weights",
               file=sys.stderr)
     if args.full_trace:
-        report = full_trace_report(exp, max_jobs=args.max_jobs)
+        report = full_trace_report(exp, max_jobs=args.max_jobs,
+                                   include_random=not args.no_random)
     else:
         report = jct_report(exp, max_steps=args.max_steps,
                             include_random=not args.no_random)

@@ -39,6 +39,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-nodes", type=int, default=None)
     p.add_argument("--gpus-per-node", type=int, default=None)
     p.add_argument("--window-jobs", type=int, default=None)
+    p.add_argument("--queue-len", type=int, default=None,
+                   help="pending-queue slots the agent sees/acts on (the "
+                        "policy's visibility into the backlog)")
     p.add_argument("--horizon", type=int, default=None)
     p.add_argument("--trace", default=None,
                    choices=["synthetic", "philly", "pai", "philly-proxy",
@@ -84,6 +87,7 @@ def apply_overrides(cfg: ExperimentConfig,
               "n_envs": args.n_envs, "n_nodes": args.n_nodes,
               "gpus_per_node": args.gpus_per_node,
               "window_jobs": args.window_jobs, "horizon": args.horizon,
+              "queue_len": args.queue_len,
               "trace": args.trace, "trace_path": args.trace_path,
               "trace_load": args.trace_load,
               "resample_every": args.resample_every}
