@@ -131,6 +131,16 @@ def windows_per_pass(total_jobs: int, window_jobs: int) -> int:
     return max(-(-total_jobs // window_jobs), 1)
 
 
+def drain_window(w: ArrayTrace) -> ArrayTrace:
+    """A backlog-drain copy of a window: every job submitted at t=0, so
+    the episode is purely "drain this backlog" — the regime where the
+    ordering/packing decision carries the whole JCT signal (see
+    ``ExperimentConfig.drain_frac``)."""
+    import numpy as np
+    return dataclasses.replace(
+        w, submit=np.where(w.valid, 0.0, np.inf).astype(np.float32))
+
+
 def make_env_windows(cfg: ExperimentConfig, source: ArrayTrace,
                      start: int = 0) -> list[ArrayTrace]:
     """Cut n_envs episode windows out of the source trace: windows
@@ -139,7 +149,11 @@ def make_env_windows(cfg: ExperimentConfig, source: ArrayTrace,
     ``n_envs`` per resample therefore sweeps the ENTIRE trace every
     ``windows_per_pass / n_envs`` resamples — round 1 trained forever on
     the first n_envs windows (VERDICT r1 missing #3). Windows are
-    demand-clamped by stack_traces at upload."""
+    demand-clamped by stack_traces at upload.
+
+    With ``cfg.drain_frac > 0`` the LAST ``round(n_envs * drain_frac)``
+    envs train on drained copies of their windows (the backlog-drain
+    curriculum); streaming resamples keep the same envs drained."""
     total = source.num_jobs
     if total < cfg.window_jobs:
         raise ValueError(f"source trace has {total} jobs < window "
@@ -150,6 +164,9 @@ def make_env_windows(cfg: ExperimentConfig, source: ArrayTrace,
         k = (start + e) % per_pass
         off = min(k * cfg.window_jobs, total - cfg.window_jobs)
         windows.append(source.slice(off, cfg.window_jobs))
+    n_drain = int(round(cfg.n_envs * cfg.drain_frac))
+    for e in range(cfg.n_envs - n_drain, cfg.n_envs):
+        windows[e] = drain_window(windows[e])
     return windows
 
 

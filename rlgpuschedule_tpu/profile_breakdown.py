@@ -1,0 +1,184 @@
+"""Where-the-time-goes profiling of the headline bench workload (L6 aux).
+
+Capability parity: SURVEY.md §5 "Tracing / profiling" and §7 hard part (d)
+("keeping per-step host↔device sync at zero"); VERDICT r2 missing #4 /
+next-round #6 — one steps/s number says nothing about WHERE the time goes,
+so this CLI decomposes the fused PPO train step into its three stages and
+measures the host gap:
+
+- **rollout**: the fused policy+env ``lax.scan`` (HOT LOOP #1),
+- **gae**: the reverse-scan advantage computation,
+- **update**: epoch × minibatch clipped-surrogate updates (HOT LOOP #2),
+- **fused_loop**: the production one-jit step (rollout+gae+update
+  together — XLA may fuse across stages, so fused ≤ sum(parts) is
+  expected) timed as a pipelined driver loop (block only at the end),
+- **fused_step_blocked**: the same step with a device sync after EVERY
+  call — the un-pipelined latency,
+- **pipeline_overlap**: blocked − pipelined = how much host work (Python
+  dispatch, PRNG splits) async dispatch hides. True device time needs the
+  profiler trace (``--trace-dir``); wall-minus-parts is NOT it, because
+  cross-stage fusion makes sum(parts) an overestimate of the fused step.
+
+Each stage is jitted separately, warmed, then timed as median-of-N
+(the same noise discipline as bench.py). Optionally captures a
+``jax.profiler`` trace (Perfetto/TensorBoard) of the fused loop.
+
+Usage::
+
+    python -m rlgpuschedule_tpu.profile_breakdown [--cpu] [--repeats 5]
+        [--trace-dir /tmp/jax-trace] [--n-envs 512] [--n-steps 128]
+
+Prints one JSON object with per-stage seconds/iteration, the stage shares,
+an env-steps/s figure, and a model-FLOPs/s estimate (policy fwd+bwd FLOPs
+from param count — the MXU utilization proxy; the env scan does almost no
+matmul work, so "MFU" here is meaningful for the update stage only).
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import statistics
+import time
+
+
+def _median_time(fn, repeats: int) -> float:
+    samples = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        samples.append(time.perf_counter() - t0)
+    return statistics.median(samples)
+
+
+def main(argv: list[str] | None = None) -> dict:
+    ap = argparse.ArgumentParser(prog="rlgpuschedule_tpu.profile_breakdown")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU platform (default: whatever jax picks)")
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--iters-per-repeat", type=int, default=3)
+    ap.add_argument("--n-envs", type=int, default=None,
+                    help="default: 512 on TPU, 32 on CPU")
+    ap.add_argument("--n-steps", type=int, default=None,
+                    help="default: 128 on TPU, 64 on CPU")
+    ap.add_argument("--trace-dir", default=None,
+                    help="also capture a jax.profiler trace of the fused "
+                         "loop here")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        from rlgpuschedule_tpu.utils.platform import force_cpu
+        force_cpu(1)
+
+    import jax
+    import jax.numpy as jnp
+
+    from rlgpuschedule_tpu.algos import PPOConfig
+    from rlgpuschedule_tpu.algos.ppo import (normalize_advantages,
+                                             run_ppo_epochs)
+    from rlgpuschedule_tpu.algos.rollout import rollout
+    from rlgpuschedule_tpu.configs import PPO_MLP_SYNTH64
+    from rlgpuschedule_tpu.experiment import Experiment
+    from rlgpuschedule_tpu.ops.gae import compute_gae
+    from rlgpuschedule_tpu.utils import profiling
+
+    platform = jax.devices()[0].platform
+    on_cpu = platform == "cpu"
+    n_envs = args.n_envs or (32 if on_cpu else 512)
+    n_steps = args.n_steps or (64 if on_cpu else 128)
+    ppo = PPOConfig(n_steps=n_steps, n_epochs=2, n_minibatches=8)
+    cfg = dataclasses.replace(PPO_MLP_SYNTH64, n_envs=n_envs, ppo=ppo)
+    exp = Experiment.build(cfg)
+    env_params, apply_fn = exp.env_params, exp.apply_fn
+    state, carry, traces = exp.train_state, exp.carry, exp.traces
+    key = jax.random.PRNGKey(0)
+
+    # ---- stage jits (no donation: inputs are reused across repeats) ------
+    @jax.jit
+    def rollout_only(params, carry):
+        return rollout(apply_fn, params, env_params, traces, carry, n_steps)
+
+    @jax.jit
+    def gae_only(tr, last_value):
+        adv, ret = compute_gae(tr.reward, tr.value, tr.done, last_value,
+                               ppo.gamma, ppo.gae_lambda)
+        return normalize_advantages(adv), ret
+
+    @jax.jit
+    def update_only(state, tr, adv, ret, key):
+        return run_ppo_epochs(
+            apply_fn, ppo, state, tr, adv, ret, key,
+            lambda s, g: s.apply_gradients(grads=g))
+
+    _, tr, last_value = jax.block_until_ready(
+        rollout_only(state.params, carry))
+    adv, ret = jax.block_until_ready(gae_only(tr, last_value))
+    jax.block_until_ready(update_only(state, tr, adv, ret, key))
+
+    fused = exp.train_step     # the production jit (donates; returns fresh)
+    state2, carry2, _ = fused(state, carry, traces, key)
+    jax.block_until_ready(state2.params)
+    state, carry = state2, carry2   # donated originals are dead now
+
+    n = args.iters_per_repeat
+    t_roll = _median_time(
+        lambda: jax.block_until_ready(
+            [rollout_only(state.params, carry) for _ in range(n)]),
+        args.repeats) / n
+    t_gae = _median_time(
+        lambda: jax.block_until_ready(
+            [gae_only(tr, last_value) for _ in range(n)]),
+        args.repeats) / n
+    t_upd = _median_time(
+        lambda: jax.block_until_ready(
+            [update_only(state, tr, adv, ret, key) for _ in range(n)]),
+        args.repeats) / n
+
+    def fused_loop(block_every: bool = False):
+        nonlocal state, carry, key
+        for _ in range(n):
+            key, sub = jax.random.split(key)
+            state, carry, _m = fused(state, carry, traces, sub)
+            if block_every:
+                jax.block_until_ready(state.params)
+        jax.block_until_ready(state.params)
+
+    t_loop = _median_time(fused_loop, args.repeats) / n
+    t_blocked = _median_time(lambda: fused_loop(True), args.repeats) / n
+
+    if args.trace_dir:
+        with profiling.trace(args.trace_dir):
+            fused_loop()
+
+    t_parts = t_roll + t_gae + t_upd
+    pipeline_overlap = max(t_blocked - t_loop, 0.0)
+
+    # model-FLOPs proxy: 2*params per fwd MAC, 3x for fwd+bwd, over every
+    # policy evaluation (T rollout steps + 1 bootstrap + epochs*B updates)
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    B = n_steps * n_envs
+    fwd_evals = B + n_envs                      # rollout + bootstrap value
+    upd_evals = ppo.n_epochs * B                # fwd+bwd per sample
+    flops = 2 * n_params * (fwd_evals + 3 * upd_evals)
+    out = {
+        "platform": platform,
+        "n_envs": n_envs, "n_steps": n_steps,
+        "seconds_per_iteration": {
+            "rollout": round(t_roll, 5), "gae": round(t_gae, 5),
+            "update": round(t_upd, 5), "fused_loop": round(t_loop, 5),
+            "fused_step_blocked": round(t_blocked, 5),
+            "pipeline_overlap": round(pipeline_overlap, 5)},
+        "stage_share_of_parts": {
+            "rollout": round(t_roll / t_parts, 3),
+            "gae": round(t_gae / t_parts, 3),
+            "update": round(t_upd / t_parts, 3)},
+        "env_steps_per_sec": round(B / t_loop, 1),
+        "policy_params": int(n_params),
+        "model_flops_per_sec": round(flops / t_loop, 1),
+    }
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main()

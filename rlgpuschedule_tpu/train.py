@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resample-every", type=int, default=None,
                    help="window streaming: rotate env windows over the "
                         "source trace every N iterations (0 = static)")
+    p.add_argument("--drain-frac", type=float, default=None,
+                   help="backlog-drain curriculum: fraction of envs that "
+                        "train on drained copies of their windows (all "
+                        "jobs at t=0)")
     # population / PBT (config 5)
     p.add_argument("--pbt", action="store_true",
                    help="train a PBT population instead of a single run")
@@ -90,7 +94,8 @@ def apply_overrides(cfg: ExperimentConfig,
               "queue_len": args.queue_len,
               "trace": args.trace, "trace_path": args.trace_path,
               "trace_load": args.trace_load,
-              "resample_every": args.resample_every}
+              "resample_every": args.resample_every,
+              "drain_frac": args.drain_frac}
     return dataclasses.replace(
         cfg, **{k: v for k, v in fields.items() if v is not None})
 

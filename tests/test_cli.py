@@ -102,12 +102,14 @@ class TestEvaluateCLI:
     def test_policy_eval_untrained(self):
         report = evaluate_cli.main(
             ["--config", "ppo-mlp-synth64", "--n-envs", "4", "--no-random",
-             "--max-steps", "64"])
+             "--n-nodes", "2", "--gpus-per-node", "4", "--window-jobs", "16",
+             "--horizon", "64", "--max-steps", "64"])
         assert "policy" in report and "vs_tiresias" in report
 
     def test_hier_policy_eval(self):
         report = evaluate_cli.main(
             ["--config", "hier-pbt-member", "--n-envs", "2", "--no-random",
-             "--max-steps", "48"])
+             "--n-nodes", "4", "--gpus-per-node", "4", "--window-jobs", "16",
+             "--horizon", "48", "--max-steps", "48"])
         assert "policy" in report and "tiresias" in report
         assert np.isfinite(report["policy"])

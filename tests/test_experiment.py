@@ -46,6 +46,23 @@ class TestConfigs:
         pai = load_source_trace(CONFIGS["a2c-pai-fair"], n_jobs=512)
         assert pai.tenant[pai.valid].max() < CONFIGS["a2c-pai-fair"].n_tenants
 
+    def test_drain_frac_zeroes_submits_for_last_envs(self):
+        """drain_frac: the last round(n_envs*frac) envs get backlog-drain
+        windows (all valid submits 0), and streaming resamples keep the
+        same envs drained."""
+        cfg = dataclasses.replace(small(CONFIGS["ppo-mlp-synth64"]),
+                                  n_envs=4, drain_frac=0.5)
+        src = load_source_trace(cfg)
+        for start in (0, 4):
+            wins = make_env_windows(cfg, src, start)
+            for e, w in enumerate(wins):
+                drained = (w.submit[w.valid] == 0.0).all()
+                assert drained == (e >= 2), (start, e)
+        # drained window still trains end-to-end
+        exp = Experiment.build(cfg)
+        out = exp.run(iterations=2)
+        assert out["env_steps"] == 2 * exp.steps_per_iteration
+
     def test_windows_cut_and_rebase(self):
         cfg = small(CONFIGS["ppo-mlp-synth64"])
         src = load_source_trace(cfg)
