@@ -80,7 +80,8 @@ def replay(apply_fn: Callable, net_params: Any,
            env_params: "EnvParams | HierParams",
            traces: core.Trace, max_steps: int | None = None,
            policy: str = "greedy", key: jax.Array | None = None,
-           ) -> EvalResult:
+           return_states: bool = False,
+           ) -> "EvalResult | tuple[EvalResult, Any]":
     """Deterministically replay the batched trace windows under the policy
     (flat configs 1-4 and the hierarchical config 5 share this harness).
 
@@ -132,11 +133,15 @@ def replay(apply_fn: Callable, net_params: Any,
     stats = jax.vmap(ops.jct_stats)(state, traces)
     makespan = ops.makespan(state)
     util = busy_time / (jnp.maximum(makespan, 1e-6) * ops.capacity)
-    return EvalResult(avg_jct=stats["avg_jct"],
-                      n_done=stats["n_done"].astype(jnp.int32),
-                      n_valid=jnp.sum(traces.valid, axis=1).astype(jnp.int32),
-                      makespan=makespan, utilization=util,
-                      steps=state.t)
+    result = EvalResult(avg_jct=stats["avg_jct"],
+                        n_done=stats["n_done"].astype(jnp.int32),
+                        n_valid=jnp.sum(traces.valid,
+                                        axis=1).astype(jnp.int32),
+                        makespan=makespan, utilization=util,
+                        steps=state.t)
+    if return_states:
+        return result, state
+    return result
 
 
 def full_trace_replay(apply_fn: Callable, net_params: Any,
@@ -428,6 +433,114 @@ def full_trace_report(exp, max_jobs: int | None = None,
     if report.get("tiresias"):
         report["vs_tiresias"] = report["policy"] / report["tiresias"]
     return report
+
+
+def jain_index(xs: np.ndarray) -> float:
+    """Jain's fairness index over per-tenant values: (Σx)²/(n·Σx²) — 1.0
+    means perfectly equal, 1/n means all dispersion on one tenant.
+    ``fairness_report`` feeds per-tenant mean RAW JCT (so a tenant whose
+    jobs are intrinsically long reads as worse-treated; use a slowdown
+    transform upstream if that distinction matters to you)."""
+    xs = np.asarray(xs, np.float64)
+    xs = xs[np.isfinite(xs) & (xs > 0)]
+    if xs.size == 0:
+        return float("nan")
+    return float(xs.sum() ** 2 / (xs.size * np.square(xs).sum()))
+
+
+def _pool_tenant_jct(finish: np.ndarray, submit: np.ndarray,
+                     tenant: np.ndarray, done: np.ndarray,
+                     n_tenants: int, sums: np.ndarray, counts: np.ndarray,
+                     ) -> None:
+    for t in range(n_tenants):
+        m = done & (tenant == t)
+        # subtract under the mask only: padding rows are inf-inf = NaN
+        # (plus a numpy warning on the CLI's stderr)
+        sums[t] += (finish[m] - submit[m]).sum()
+        counts[t] += m.sum()
+
+
+def fairness_report(exp, windows: list[ArrayTrace] | None = None,
+                    max_steps: int | None = None,
+                    baselines: tuple[str, ...] = ("fifo", "sjf", "srtf",
+                                                  "tiresias"),
+                    ) -> dict[str, Any]:
+    """Multi-tenant fairness table (config 3, SURVEY.md §0 "multi-tenant
+    fairness reward"): per-tenant avg JCT under the trained policy vs the
+    oracle baselines on identical windows, summarized by Jain's index over
+    per-tenant means (1.0 = perfectly even treatment) next to each
+    scheduler's plain avg JCT — the quantitative form of "did the fairness
+    reward buy evener tenants without wrecking JCT".
+
+    Returns ``{"<name>": {"avg_jct": .., "jain": ..,
+    "tenant_avg_jct": [..]}, ...}`` with ``policy`` as one of the rows."""
+    if isinstance(exp.env_params, HierParams):
+        raise ValueError("fairness_report supports flat configs (tenant "
+                         "ids live in the flat sim's trace)")
+    n_tenants = max(int(exp.cfg.n_tenants), 1)
+    if windows is None:
+        windows, traces = exp.windows, exp.traces
+    else:
+        traces = env_lib.stack_traces(windows, exp.env_params)
+
+    out: dict[str, Any] = {}
+    _res, states = replay(exp.apply_fn, exp.train_state.params,
+                          exp.env_params, traces, max_steps,
+                          return_states=True)
+    sums = np.zeros(n_tenants)
+    counts = np.zeros(n_tenants, np.int64)
+    sim = jax.tree.map(np.asarray, states.sim)
+    tr = jax.tree.map(np.asarray, traces)
+    for e in range(sim.finish.shape[0]):
+        done = tr.valid[e] & np.isfinite(sim.finish[e])
+        _pool_tenant_jct(sim.finish[e], tr.submit[e], tr.tenant[e], done,
+                         n_tenants, sums, counts)
+    per_tenant = np.where(counts > 0, sums / np.maximum(counts, 1), np.nan)
+    n_valid = int(sum(w.num_jobs for w in windows))
+    out["policy"] = {
+        # NaN (not 0.0) when nothing completed, so a truncated replay
+        # cannot sort itself to the top of the table; completion surfaces
+        # the survivor bias a max_steps cut introduces (the baselines
+        # always run to completion)
+        "avg_jct": (float(sums.sum() / counts.sum()) if counts.sum()
+                    else float("nan")),
+        "jain": jain_index(per_tenant),
+        "completion": float(counts.sum() / max(n_valid, 1)),
+        "tenant_avg_jct": [round(float(x), 1) for x in per_tenant]}
+
+    for name in baselines:
+        sums = np.zeros(n_tenants)
+        counts = np.zeros(n_tenants, np.int64)
+        for w in windows:
+            bl = run_baseline(w, exp.cfg.n_nodes, exp.cfg.gpus_per_node,
+                              name)
+            done = w.valid & np.isfinite(np.asarray(bl.finish, np.float64))
+            _pool_tenant_jct(np.asarray(bl.finish, np.float64),
+                             np.asarray(w.submit, np.float64),
+                             np.asarray(w.tenant), done, n_tenants,
+                             sums, counts)
+        per_tenant = np.where(counts > 0, sums / np.maximum(counts, 1),
+                              np.nan)
+        out[name] = {
+            "avg_jct": (float(sums.sum() / counts.sum()) if counts.sum()
+                        else float("nan")),
+            "jain": jain_index(per_tenant),
+            "completion": float(counts.sum() / max(n_valid, 1)),
+            "tenant_avg_jct": [round(float(x), 1) for x in per_tenant]}
+    return out
+
+
+def format_fairness(report: dict[str, Any]) -> str:
+    width = max(len(k) for k in report)
+    lines = [f"{'scheduler':<{width}}  avg JCT (s)  Jain(tenant JCT)  done",
+             f"{'-' * width}  -----------  ----------------  ----"]
+    order = sorted(report.items(),
+                   key=lambda kv: (np.isnan(kv[1]["avg_jct"]),
+                                   kv[1]["avg_jct"]))
+    for k, v in order:
+        lines.append(f"{k:<{width}}  {v['avg_jct']:>11.1f}  "
+                     f"{v['jain']:>16.3f}  {v['completion']:>4.0%}")
+    return "\n".join(lines)
 
 
 def format_report(report: dict[str, Any]) -> str:

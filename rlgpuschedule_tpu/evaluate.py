@@ -45,6 +45,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--baselines-only", action="store_true")
     p.add_argument("--no-random", action="store_true",
                    help="skip the random-policy column")
+    p.add_argument("--fairness", action="store_true",
+                   help="multi-tenant fairness table: per-tenant avg JCT "
+                        "+ Jain index, policy vs baselines (config 3)")
     p.add_argument("--full-trace", action="store_true",
                    help="evaluate over the ENTIRE source trace: policy via "
                         "sequential windowed replay with residual carry, "
@@ -69,8 +72,8 @@ def main(argv: list[str] | None = None) -> dict:
              "horizon": args.horizon}.items() if v is not None}
     cfg = dataclasses.replace(cfg, **over)
 
-    from .eval import (baseline_jct_table, format_report, full_trace_report,
-                       jct_report)
+    from .eval import (baseline_jct_table, fairness_report, format_fairness,
+                       format_report, full_trace_report, jct_report)
     from .experiment import Experiment, build_stack
 
     if args.baselines_only:
@@ -90,6 +93,11 @@ def main(argv: list[str] | None = None) -> dict:
     else:
         print("note: no --ckpt-dir; evaluating untrained init weights",
               file=sys.stderr)
+    if args.fairness:
+        report = fairness_report(exp, max_steps=args.max_steps)
+        print(format_fairness(report), file=sys.stderr)
+        print(json.dumps(report))
+        return report
     if args.full_trace:
         report = full_trace_report(exp, max_jobs=args.max_jobs,
                                    include_random=not args.no_random)

@@ -95,6 +95,32 @@ class TestJctTable:
         assert "fifo" in report and "random" not in report
 
 
+class TestFairnessReport:
+    def test_tenant_table_and_jain(self):
+        """fairness_report (config 3's quality metric): per-tenant avg JCT
+        pooled over windows for policy + baselines, Jain index in (0, 1]."""
+        cfg = dataclasses.replace(
+            small_cfg(), reward_kind="fair", n_tenants=3)
+        exp = Experiment.build(cfg)
+        rep = eval_lib.fairness_report(exp, max_steps=64,
+                                       baselines=("fifo", "sjf"))
+        assert set(rep) == {"policy", "fifo", "sjf"}
+        for row in rep.values():
+            assert np.isfinite(row["avg_jct"]) and row["avg_jct"] > 0
+            assert 0 < row["jain"] <= 1.0
+            assert 0 < row["completion"] <= 1.0
+            assert len(row["tenant_avg_jct"]) == 3
+        # baselines' per-tenant means must average (job-weighted) to the
+        # plain table's numbers on the same windows
+        plain = eval_lib.baseline_jct_table(exp.windows, cfg.n_nodes,
+                                            cfg.gpus_per_node,
+                                            names=("fifo",))
+        assert rep["fifo"]["avg_jct"] == pytest.approx(plain["fifo"],
+                                                       rel=1e-6)
+        out = eval_lib.format_fairness(rep)
+        assert "Jain" in out and "policy" in out
+
+
 class TestFullTraceReplay:
     def test_single_window_matches_plain_replay(self):
         """With max_jobs >= the whole trace, the stitched replay is one

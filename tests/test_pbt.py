@@ -197,11 +197,13 @@ class TestPopulationExperiment:
         build = lambda: PopulationExperiment.build(
             TINY, n_pop=4, mesh=None, pbt_cfg=PBTConfig(ready_iters=2,
                                                         seed=3))
-        # the TRUE uninterrupted reference: one run() call, 7 iterations
+        # the TRUE uninterrupted reference: one run() call, 5 iterations
         # (not a second run() call, which would share any local-index
-        # artifact with the resumed run and mask it)
+        # artifact with the resumed run and mask it). ready_iters=2 over
+        # 5 iters = exploit rounds at i=1 and i=3; the checkpoint at i=3
+        # carries 1 pending window record into the resumed leg.
         exp = build()
-        exp.run(iterations=7)
+        exp.run(iterations=5)
         final = jax.tree.map(np.asarray, exp.states.params)
 
         exp1 = build()
@@ -212,7 +214,7 @@ class TestPopulationExperiment:
             exp2 = build()
             meta = exp2.restore_checkpoint(ck)
         assert meta["pbt_events"] == len(exp2.controller.history)
-        exp2.run(iterations=4)      # resumed continuation
+        exp2.run(iterations=2)      # resumed continuation
         final2 = jax.tree.map(np.asarray, exp2.states.params)
         for a, b in zip(jax.tree.leaves(final), jax.tree.leaves(final2)):
             np.testing.assert_array_equal(a, b)
