@@ -477,11 +477,18 @@ def fairness_report(exp, windows: list[ArrayTrace] | None = None,
     if isinstance(exp.env_params, HierParams):
         raise ValueError("fairness_report supports flat configs (tenant "
                          "ids live in the flat sim's trace)")
-    n_tenants = max(int(exp.cfg.n_tenants), 1)
     if windows is None:
         windows, traces = exp.windows, exp.traces
     else:
         traces = env_lib.stack_traces(windows, exp.env_params)
+    # pool over every tenant id actually present, not just
+    # cfg.n_tenants bins: a real PAI CSV maps each distinct user to a
+    # dense id unbounded by the config, and silently dropping tenants
+    # >= n_tenants would skew avg_jct/Jain/completion for every row
+    n_tenants = max(int(exp.cfg.n_tenants), 1,
+                    1 + max((int(np.asarray(w.tenant)[w.valid].max())
+                             for w in windows if w.valid.any()),
+                            default=0))
 
     out: dict[str, Any] = {}
     _res, states = replay(exp.apply_fn, exp.train_state.params,
@@ -531,7 +538,7 @@ def fairness_report(exp, windows: list[ArrayTrace] | None = None,
 
 
 def format_fairness(report: dict[str, Any]) -> str:
-    width = max(len(k) for k in report)
+    width = max(len("scheduler"), *(len(k) for k in report))
     lines = [f"{'scheduler':<{width}}  avg JCT (s)  Jain(tenant JCT)  done",
              f"{'-' * width}  -----------  ----------------  ----"]
     order = sorted(report.items(),

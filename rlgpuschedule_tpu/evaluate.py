@@ -37,6 +37,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--window-jobs", type=int, default=None)
     p.add_argument("--queue-len", type=int, default=None)
     p.add_argument("--horizon", type=int, default=None)
+    p.add_argument("--drain-frac", type=float, default=None,
+                   help="evaluate on backlog-drain copies of this fraction "
+                        "of the windows (all jobs at t=0) — the regime the "
+                        "drain curriculum trains on; use 1.0 to reproduce "
+                        "the BASELINE.md drain tables")
     p.add_argument("--ckpt-dir", default=None,
                    help="restore the trained policy from this checkpoint "
                         "dir (omit = untrained init weights)")
@@ -69,7 +74,8 @@ def main(argv: list[str] | None = None) -> dict:
              "n_envs": args.n_envs, "n_nodes": args.n_nodes,
              "gpus_per_node": args.gpus_per_node,
              "window_jobs": args.window_jobs, "queue_len": args.queue_len,
-             "horizon": args.horizon}.items() if v is not None}
+             "horizon": args.horizon,
+             "drain_frac": args.drain_frac}.items() if v is not None}
     cfg = dataclasses.replace(cfg, **over)
 
     from .eval import (baseline_jct_table, fairness_report, format_fairness,
@@ -96,7 +102,20 @@ def main(argv: list[str] | None = None) -> dict:
     if args.fairness:
         report = fairness_report(exp, max_steps=args.max_steps)
         print(format_fairness(report), file=sys.stderr)
-        print(json.dumps(report))
+        import math
+
+        # NaN is the deliberate nothing-completed sentinel, but bare NaN
+        # tokens are invalid JSON — emit null so strict parsers (jq etc.)
+        # can consume the CLI output
+        def _json_safe(v):
+            if isinstance(v, float) and not math.isfinite(v):
+                return None
+            if isinstance(v, dict):
+                return {k: _json_safe(x) for k, x in v.items()}
+            if isinstance(v, list):
+                return [_json_safe(x) for x in v]
+            return v
+        print(json.dumps(_json_safe(report)))
         return report
     if args.full_trace:
         report = full_trace_report(exp, max_jobs=args.max_jobs,

@@ -68,6 +68,9 @@ def build_parser() -> argparse.ArgumentParser:
     # logging / checkpointing / profiling
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--log-csv", default=None)
+    p.add_argument("--tb-dir", default=None,
+                   help="also write scalar curves as a TensorBoard event "
+                        "file under this directory")
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--ckpt-every", type=int, default=50)
     p.add_argument("--resume", action="store_true",
@@ -140,8 +143,16 @@ def main(argv: list[str] | None = None) -> dict:
         ckpt = Checkpointer(os.path.abspath(args.ckpt_dir))
 
     with contextlib.ExitStack() as stack:
-        logger = stack.enter_context(
+        csv_logger = stack.enter_context(
             MetricsLogger(args.log_csv, echo=args.log_every > 0))
+        logger = csv_logger
+        if args.tb_dir:
+            from .utils import TensorBoardWriter
+            tb = stack.enter_context(TensorBoardWriter(args.tb_dir))
+
+            def logger(i, m, _csv=csv_logger, _tb=tb):
+                _csv(i, m)
+                _tb(i, m)
         if args.profile_dir:
             stack.enter_context(profiling.trace(args.profile_dir))
         if args.debug_nans:

@@ -1,6 +1,6 @@
 """L6 auxiliary utilities: metrics logging, profiling/tracing."""
-from .logging import MetricsLogger, ThroughputMeter
+from .logging import MetricsLogger, TensorBoardWriter, ThroughputMeter
 from .profiling import trace, debug_checks, SectionTimer
 
-__all__ = ["MetricsLogger", "ThroughputMeter", "trace", "debug_checks",
-           "SectionTimer"]
+__all__ = ["MetricsLogger", "TensorBoardWriter", "ThroughputMeter",
+           "trace", "debug_checks", "SectionTimer"]

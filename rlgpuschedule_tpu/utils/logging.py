@@ -77,6 +77,125 @@ class MetricsLogger:
         self.close()
 
 
+def _crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli), table-driven — the checksum TFRecord framing
+    requires. Pure Python: the write cadence is one small record per logged
+    iteration, so speed is irrelevant and we avoid a tensorflow import."""
+    table = _crc32c_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+_CRC_TABLE: list[int] | None = None
+
+
+def _crc32c_table() -> list[int]:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for n in range(256):
+            crc = n
+            for _ in range(8):
+                crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+            table.append(crc)
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:   # proto int64: 10-byte two's-complement encoding
+        n &= 0xFFFFFFFFFFFFFFFF
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _tb_event(wall_time: float, step: int,
+              scalars: Mapping[str, float] | None = None,
+              file_version: str | None = None) -> bytes:
+    """Hand-encoded ``tensorflow.Event`` proto: wall_time (field 1,
+    double), step (field 2, int64), file_version (3, string) or summary
+    (5, message of Value{tag=1 string, simple_value=2 float})."""
+    import struct
+    ev = bytearray()
+    ev += b"\x09" + struct.pack("<d", wall_time)
+    ev += b"\x10" + _varint(step)
+    if file_version is not None:
+        fv = file_version.encode()
+        ev += b"\x1a" + _varint(len(fv)) + fv
+    if scalars:
+        summary = bytearray()
+        for tag, val in scalars.items():
+            t = tag.encode()
+            value = (b"\x0a" + _varint(len(t)) + t +
+                     b"\x15" + struct.pack("<f", float(val)))
+            summary += b"\x0a" + _varint(len(value)) + value
+        ev += b"\x2a" + _varint(len(summary)) + bytes(summary)
+    return bytes(ev)
+
+
+class TensorBoardWriter:
+    """Scalar curves as a TensorBoard event file — the reference family's
+    usual dashboard (SURVEY.md §5 "Metrics / logging: TensorBoard [?]").
+
+    Dependency-free by design: encodes the ``Event`` protobuf and TFRecord
+    framing (length + masked-crc32c) by hand, ~40 lines instead of a
+    tensorflow/tensorboard import on the training host. Files read back
+    with any stock TensorBoard (round-trip pinned in tests/test_cli.py).
+
+    >>> with TensorBoardWriter("out/tb") as tb:
+    ...     tb(10, {"mean_reward": -0.5})
+    """
+
+    def __init__(self, logdir: str):
+        import socket
+        os.makedirs(logdir, exist_ok=True)
+        name = (f"events.out.tfevents.{int(time.time())}."
+                f"{socket.gethostname()}.{os.getpid()}")
+        self.path = os.path.join(logdir, name)
+        self._file: IO[bytes] = open(self.path, "wb")
+        self._record(_tb_event(time.time(), 0,
+                               file_version="brain.Event:2"))
+
+    def _record(self, payload: bytes) -> None:
+        import struct
+        header = struct.pack("<Q", len(payload))
+        self._file.write(header)
+        self._file.write(struct.pack("<I", _masked_crc(header)))
+        self._file.write(payload)
+        self._file.write(struct.pack("<I", _masked_crc(payload)))
+        self._file.flush()
+
+    def __call__(self, step: int, metrics: Mapping[str, Any]) -> None:
+        scalars = {k: float(v) for k, v in metrics.items()
+                   if hasattr(v, "__float__")}
+        if scalars:
+            self._record(_tb_event(time.time(), int(step), scalars))
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "TensorBoardWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 class ThroughputMeter:
     """env-steps/sec tracker for the north-star throughput metric
     (SURVEY.md §6 metric #1). Call ``tick(n_steps)`` once per iteration."""

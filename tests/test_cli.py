@@ -33,6 +33,25 @@ class TestMetricsLogger:
         m.tick(100)
         assert m.steps_per_sec > 0
 
+    def test_tensorboard_writer_roundtrip(self, tmp_path):
+        # the hand-encoded Event/TFRecord bytes must read back through
+        # stock TensorBoard's own loader (crc framing + proto layout)
+        tb_mod = pytest.importorskip(
+            "tensorboard.backend.event_processing.event_file_loader")
+        from rlgpuschedule_tpu.utils import TensorBoardWriter
+        with TensorBoardWriter(str(tmp_path)) as tb:
+            tb(3, {"mean_reward": -0.5, "note": "skipped-non-float"})
+            tb(7, {"mean_reward": 1.25})
+            path = tb.path
+        from tensorboard.compat.proto import event_pb2
+        events = [event_pb2.Event.FromString(raw) for raw in
+                  tb_mod.RawEventFileLoader(path).Load()]
+        assert events[0].file_version == "brain.Event:2"
+        scalars = {(e.step, v.tag): v.simple_value
+                   for e in events[1:] for v in e.summary.value}
+        assert scalars[(3, "mean_reward")] == -0.5
+        assert scalars[(7, "mean_reward")] == 1.25
+
     def test_section_timer(self):
         t = SectionTimer()
         with t("a"):
@@ -105,6 +124,20 @@ class TestEvaluateCLI:
              "--n-nodes", "2", "--gpus-per-node", "4", "--window-jobs", "16",
              "--horizon", "64", "--max-steps", "64"])
         assert "policy" in report and "vs_tiresias" in report
+
+    def test_drain_frac_eval(self):
+        # --drain-frac 1.0 evaluates on backlog-drain copies of the
+        # windows: every valid job submits at t=0, so the baseline FIFO
+        # JCT must differ from the streaming-windows evaluation of the
+        # same config (reproduces the BASELINE.md drain tables)
+        common = ["--config", "ppo-mlp-synth64", "--n-envs", "4",
+                  "--no-random", "--n-nodes", "2", "--gpus-per-node", "4",
+                  "--window-jobs", "16", "--horizon", "64",
+                  "--max-steps", "64"]
+        stream = evaluate_cli.main(common)
+        drain = evaluate_cli.main(common + ["--drain-frac", "1.0"])
+        assert np.isfinite(drain["policy"])
+        assert drain["fifo"] != stream["fifo"]
 
     def test_hier_policy_eval(self):
         report = evaluate_cli.main(

@@ -120,6 +120,28 @@ class TestFairnessReport:
         out = eval_lib.format_fairness(rep)
         assert "Jain" in out and "policy" in out
 
+    def test_tenant_ids_beyond_config_bins_still_pooled(self):
+        """A real CSV maps each distinct user to a dense id unbounded by
+        cfg.n_tenants; jobs of tenants >= n_tenants must still count
+        (the pre-fix code silently dropped them from every row)."""
+        cfg = dataclasses.replace(
+            small_cfg(), reward_kind="fair", n_tenants=2)
+        exp = Experiment.build(cfg)
+        windows = []
+        for w in exp.windows:
+            t = np.asarray(w.tenant).copy()
+            t[w.valid] = 2 + (np.flatnonzero(w.valid) % 3)   # ids 2..4
+            windows.append(dataclasses.replace(w, tenant=t))
+        rep = eval_lib.fairness_report(exp, windows=windows, max_steps=64,
+                                       baselines=("fifo",))
+        assert rep["fifo"]["completion"] == pytest.approx(1.0)
+        assert len(rep["fifo"]["tenant_avg_jct"]) == 5
+        plain = eval_lib.baseline_jct_table(windows, cfg.n_nodes,
+                                            cfg.gpus_per_node,
+                                            names=("fifo",))
+        assert rep["fifo"]["avg_jct"] == pytest.approx(plain["fifo"],
+                                                       rel=1e-6)
+
 
 class TestFullTraceReplay:
     def test_single_window_matches_plain_replay(self):
