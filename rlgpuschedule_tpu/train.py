@@ -59,6 +59,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="backlog-drain curriculum: fraction of envs that "
                         "train on drained copies of their windows (all "
                         "jobs at t=0)")
+    # algorithm hyperparameter overrides (apply to the active algo's
+    # config — cfg.ppo or cfg.a2c; None = keep preset value). Large-batch
+    # TPU runs typically want a higher --lr than the preset 3e-4, which
+    # was tuned at config-1 batch sizes.
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--ent-coef", type=float, default=None)
+    p.add_argument("--n-steps", type=int, default=None,
+                   help="rollout length T per iteration")
+    p.add_argument("--n-epochs", type=int, default=None,
+                   help="PPO update epochs per iteration (PPO only)")
+    p.add_argument("--n-minibatches", type=int, default=None)
     # population / PBT (config 5)
     p.add_argument("--pbt", action="store_true",
                    help="train a PBT population instead of a single run")
@@ -99,8 +110,23 @@ def apply_overrides(cfg: ExperimentConfig,
               "trace_load": args.trace_load,
               "resample_every": args.resample_every,
               "drain_frac": args.drain_frac}
-    return dataclasses.replace(
+    cfg = dataclasses.replace(
         cfg, **{k: v for k, v in fields.items() if v is not None})
+    algo_fields = {"lr": args.lr, "ent_coef": args.ent_coef,
+                   "n_steps": args.n_steps}
+    if cfg.algo == "ppo":
+        algo_fields["n_epochs"] = args.n_epochs
+        algo_fields["n_minibatches"] = args.n_minibatches
+    elif args.n_epochs is not None or args.n_minibatches is not None:
+        raise SystemExit("--n-epochs/--n-minibatches apply to PPO configs "
+                         "only (A2C does one full-batch update per "
+                         "iteration)")
+    over = {k: v for k, v in algo_fields.items() if v is not None}
+    if over:
+        algo = "ppo" if cfg.algo == "ppo" else "a2c"
+        cfg = dataclasses.replace(
+            cfg, **{algo: dataclasses.replace(getattr(cfg, algo), **over)})
+    return cfg
 
 
 def make_pop_mesh(n_pop: int):

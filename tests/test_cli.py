@@ -106,6 +106,27 @@ class TestTrainCLI:
         assert summary["pbt_events"] >= 1
         assert all(np.isfinite(summary["final_fitness"]))
 
+    def test_algo_hparam_overrides(self):
+        # --lr/--ent-coef/--n-steps/--n-epochs/--n-minibatches land in the
+        # active algo's config; PPO-only knobs are rejected for A2C
+        args = train_cli.build_parser().parse_args(
+            ["--config", "ppo-mlp-synth64", "--lr", "1e-3",
+             "--n-steps", "32", "--n-epochs", "2", "--n-minibatches", "2",
+             "--ent-coef", "0.02"])
+        from rlgpuschedule_tpu.configs import CONFIGS
+        cfg = train_cli.apply_overrides(CONFIGS["ppo-mlp-synth64"], args)
+        assert (cfg.ppo.lr, cfg.ppo.n_steps, cfg.ppo.n_epochs,
+                cfg.ppo.n_minibatches, cfg.ppo.ent_coef) == \
+            (1e-3, 32, 2, 2, 0.02)
+        a2c_args = train_cli.build_parser().parse_args(
+            ["--config", "a2c-pai-fair", "--lr", "1e-3", "--n-steps", "8"])
+        cfg = train_cli.apply_overrides(CONFIGS["a2c-pai-fair"], a2c_args)
+        assert (cfg.a2c.lr, cfg.a2c.n_steps) == (1e-3, 8)
+        bad = train_cli.build_parser().parse_args(
+            ["--config", "a2c-pai-fair", "--n-epochs", "2"])
+        with pytest.raises(SystemExit):
+            train_cli.apply_overrides(CONFIGS["a2c-pai-fair"], bad)
+
     def test_report_flag(self, capsys):
         summary = train_cli.main(
             ["--config", "ppo-mlp-synth64", *FAST, "--report"])
