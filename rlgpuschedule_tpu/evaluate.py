@@ -47,6 +47,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "dir (omit = untrained init weights)")
     p.add_argument("--ckpt-step", type=int, default=None)
     p.add_argument("--max-steps", type=int, default=None)
+    p.add_argument("--pbt", action="store_true",
+                   help="evaluate a PBT population checkpoint (config 5): "
+                        "restores the population from --ckpt-dir and "
+                        "replays one member")
+    p.add_argument("--n-pop", type=int, default=4,
+                   help="with --pbt: population size of the training run")
+    p.add_argument("--member", type=int, default=None,
+                   help="with --pbt: member index to evaluate (default: "
+                        "fittest by the controller's windowed fitness)")
     p.add_argument("--baselines-only", action="store_true")
     p.add_argument("--no-random", action="store_true",
                    help="skip the random-policy column")
@@ -89,16 +98,33 @@ def main(argv: list[str] | None = None) -> dict:
         print(json.dumps(report))
         return report
 
-    exp = Experiment.build(cfg)
-    if args.ckpt_dir:
-        from .checkpoint import Checkpointer
-        import os
-        with Checkpointer(os.path.abspath(args.ckpt_dir)) as ckpt:
-            exp.restore_checkpoint(ckpt, step=args.ckpt_step)
-        print(f"policy restored from {args.ckpt_dir}", file=sys.stderr)
-    else:
-        print("note: no --ckpt-dir; evaluating untrained init weights",
+    def restore(target, label: str) -> None:
+        if args.ckpt_dir:
+            from .checkpoint import Checkpointer
+            import os
+            with Checkpointer(os.path.abspath(args.ckpt_dir)) as ckpt:
+                target.restore_checkpoint(ckpt, step=args.ckpt_step)
+            print(f"{label} restored from {args.ckpt_dir}", file=sys.stderr)
+        else:
+            print("note: no --ckpt-dir; evaluating untrained init weights",
+                  file=sys.stderr)
+
+    if args.pbt:
+        if args.fairness or args.full_trace:
+            sys.exit("--pbt supports the per-window JCT table "
+                     "(hierarchical members replay per-window)")
+        from .experiment import PopulationExperiment
+        pop = PopulationExperiment.build(cfg, n_pop=args.n_pop)
+        restore(pop, "population")
+        # untrained populations have no fitness record to rank by
+        member = args.member if args.member is not None else \
+            (None if args.ckpt_dir else 0)
+        exp = pop.member_eval_view(member)
+        print(f"evaluating member {exp.member} of {args.n_pop}",
               file=sys.stderr)
+    else:
+        exp = Experiment.build(cfg)
+        restore(exp, "policy")
     if args.fairness:
         report = fairness_report(exp, max_steps=args.max_steps)
         print(format_fairness(report), file=sys.stderr)

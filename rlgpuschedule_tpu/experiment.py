@@ -328,7 +328,7 @@ class PopulationExperiment:
     cfg: ExperimentConfig
     n_pop: int
     env_params: EnvParams
-    traces: Any              # [P, E, ...] batched device Trace
+    traces: Any              # [E, ...] batched device Trace (shared)
     apply_fn: Callable
     states: Any              # stacked MemberState [P, ...]
     carries: Any             # stacked RolloutCarry [P, ...]
@@ -336,6 +336,7 @@ class PopulationExperiment:
     keys: jax.Array          # [P, 2] per-member rollout keys
     pop_step: Callable       # jitted
     controller: Any          # PBTController
+    windows: list = None     # host ArrayTrace windows (shared; eval reuse)
 
     @staticmethod
     def build(cfg: ExperimentConfig, n_pop: int = 4, mesh=None,
@@ -350,7 +351,7 @@ class PopulationExperiment:
                 f"PPO hyperparameters); config {cfg.name!r} has "
                 f"algo={cfg.algo!r}")
         pbt_cfg = pbt_cfg or PBTConfig(seed=cfg.seed)
-        env_params, _windows, traces, net, apply_fn, extra, _source = \
+        env_params, windows, traces, net, apply_fn, extra, _source = \
             build_stack(cfg)
         # traces stay unstacked [E, ...]: every member trains on the same
         # env windows (PBT fitness comparability) and the vmapped step
@@ -393,11 +394,44 @@ class PopulationExperiment:
             cfg=cfg, n_pop=n_pop, env_params=env_params, traces=traces,
             apply_fn=apply_fn, states=states, carries=stacked_carries,
             hparams=hparams, keys=keys, pop_step=jitted,
-            controller=PBTController(n_pop, pbt_cfg))
+            controller=PBTController(n_pop, pbt_cfg), windows=windows)
 
     @property
     def steps_per_iteration(self) -> int:
         return self.cfg.ppo.n_steps * self.cfg.n_envs * self.n_pop
+
+    def best_member(self) -> int:
+        """Index of the fittest member by windowed mean fitness (NaN ranks
+        worst — same ordering PBT exploit uses). Raises when the controller
+        holds no recorded fitness (e.g. a population checkpoint saved
+        before controller state was persisted): argmax over the all-zero
+        default would silently crown member 0."""
+        import numpy as np
+        if self.controller._fitness_n == 0 and not self.controller.history:
+            raise ValueError(
+                "population has no recorded fitness (pre-controller-state "
+                "checkpoint, or no training iterations ran); pass an "
+                "explicit member index instead")
+        f = np.asarray(self.controller.mean_fitness, np.float64)
+        return int(np.nanargmax(np.where(np.isnan(f), -np.inf, f)))
+
+    def member_eval_view(self, m: int | None = None):
+        """Experiment-like view of one population member for the eval
+        harness (``eval.jct_report(pop.member_eval_view())``): the member's
+        params indexed out of the stacked MemberState (materialized on the
+        default device — the eval replay is unsharded), sharing the
+        population's windows/traces/env_params. Default: fittest member."""
+        import types
+        m = self.best_member() if m is None else m
+        if not 0 <= m < self.n_pop:
+            raise ValueError(f"member {m} out of range [0, {self.n_pop})")
+        params = jax.tree.map(
+            lambda x: jax.device_put(x[m], jax.devices()[0]),
+            self.states.params)
+        return types.SimpleNamespace(
+            cfg=self.cfg, env_params=self.env_params, windows=self.windows,
+            traces=self.traces, apply_fn=self.apply_fn,
+            train_state=types.SimpleNamespace(params=params), member=m)
 
     def save_checkpoint(self, ckpt, step: int | None = None,
                         meta: dict | None = None, force: bool = False) -> bool:

@@ -160,6 +160,24 @@ class TestEvaluateCLI:
         assert np.isfinite(drain["policy"])
         assert drain["fifo"] != stream["fifo"]
 
+    def test_pbt_population_eval(self, tmp_path):
+        # config-5 eval path: train a tiny PBT population, checkpoint it,
+        # then restore + replay the fittest member against the baselines
+        ckpt_dir = str(tmp_path / "pop")
+        small = ["--n-envs", "4", "--n-nodes", "4", "--gpus-per-node", "4",
+                 "--window-jobs", "16", "--horizon", "48"]
+        train_cli.main(
+            ["--config", "hier-pbt-member", "--pbt", "--n-pop", "2",
+             "--pbt-ready", "1", "--iterations", "2", *small,
+             "--log-every", "0", "--ckpt-dir", ckpt_dir,
+             "--ckpt-every", "2"])
+        report = evaluate_cli.main(
+            ["--config", "hier-pbt-member", "--pbt", "--n-pop", "2",
+             *small, "--max-steps", "48", "--no-random",
+             "--ckpt-dir", ckpt_dir])
+        assert "policy" in report and "tiresias" in report
+        assert np.isfinite(report["policy"])
+
     def test_hier_policy_eval(self):
         report = evaluate_cli.main(
             ["--config", "hier-pbt-member", "--n-envs", "2", "--no-random",
