@@ -333,6 +333,20 @@ def pooled_avg_jct(result: EvalResult) -> tuple[float, float]:
     return float((jct * n).sum() / max(total, 1.0)), frac
 
 
+def baseline_jcts(windows: list[ArrayTrace], n_nodes: int,
+                  gpus_per_node: int, name: str) -> np.ndarray:
+    """Pooled per-job JCTs of one baseline over the windows (completed
+    valid jobs only) — the array behind both the mean and the percentile
+    columns."""
+    jcts = []
+    for w in windows:
+        sim = run_baseline(w, n_nodes, gpus_per_node, name)
+        finish = np.asarray(sim.finish, np.float64)
+        done = np.asarray(w.valid) & np.isfinite(finish)
+        jcts.append(finish[done] - np.asarray(w.submit, np.float64)[done])
+    return np.concatenate(jcts) if jcts else np.zeros(0)
+
+
 def baseline_jct_table(windows: list[ArrayTrace], n_nodes: int,
                        gpus_per_node: int,
                        names: tuple[str, ...] = ("fifo", "sjf", "srtf",
@@ -340,60 +354,92 @@ def baseline_jct_table(windows: list[ArrayTrace], n_nodes: int,
                        ) -> dict[str, float]:
     """Completion-weighted avg JCT per baseline over the same windows the
     policy is evaluated on (oracle event-driven replay, SURVEY.md §3.4)."""
-    out: dict[str, float] = {}
-    for name in names:
-        tot_jct, tot_n = 0.0, 0
-        for w in windows:
-            sim = run_baseline(w, n_nodes, gpus_per_node, name)
-            n = sum(1 for j in range(w.max_jobs)
-                    if w.valid[j] and np.isfinite(sim.finish[j]))
-            tot_jct += sim.avg_jct() * n
-            tot_n += n
-        out[name] = tot_jct / max(tot_n, 1)
-    return out
+    return {name: float(np.mean(jcts)) if (jcts := baseline_jcts(
+                windows, n_nodes, gpus_per_node, name)).size else 0.0
+            for name in names}
+
+
+def _replay_jcts(states, traces) -> np.ndarray:
+    """Pooled per-job JCTs (completed valid jobs) from replay end states."""
+    sim = jax.tree.map(np.asarray, states.sim)
+    tr = jax.tree.map(np.asarray, traces)
+    finish = np.asarray(sim.finish, np.float64)
+    done = tr.valid & np.isfinite(finish)
+    return (finish[done] - np.asarray(tr.submit, np.float64)[done])
 
 
 def jct_report(exp, windows: list[ArrayTrace] | None = None,
                max_steps: int | None = None,
                baselines: tuple[str, ...] = ("fifo", "sjf", "srtf",
                                              "tiresias"),
-               include_random: bool = True) -> dict[str, Any]:
+               include_random: bool = True,
+               percentiles: tuple[float, ...] | None = None,
+               ) -> dict[str, Any]:
     """The full comparison table for an assembled Experiment: trained-policy
     greedy replay vs oracle baselines on identical windows.
 
     Returns {"policy": jct, "random": jct, <baseline>: jct, ...,
     "policy_completion": frac, "vs_tiresias": ratio} — ratio < 1.0 means the
-    policy beats Tiresias (north-star #2, SURVEY.md §6).
+    policy beats Tiresias (north-star #2, SURVEY.md §6). With
+    ``percentiles`` (e.g. ``(50, 90, 99)``) the report also carries
+    ``report["percentiles"][<row>]["p90"]`` tail-latency columns per
+    scheduler (SURVEY.md §2 "avg/percentile JCT") — flat configs only (the
+    hierarchical end state keeps per-pod tables, not a flat finish array).
 
     For hierarchical experiments (config 5) the policy schedules gangs
     within pods while the oracle baselines use the whole flat cluster —
     the baselines get strictly more placement freedom, so the comparison
     is conservative for the policy.
     """
+    is_hier = isinstance(exp.env_params, HierParams)
+    if percentiles is not None and is_hier:
+        raise ValueError("percentiles are supported for flat configs")
     if windows is None:
         # the windows the experiment trained on (already validated/clamped
         # at build) — no re-ingest of the source trace
         windows, traces = exp.windows, exp.traces
     else:
-        params = (exp.env_params.pod_sim
-                  if isinstance(exp.env_params, HierParams)
-                  else exp.env_params)
+        params = exp.env_params.pod_sim if is_hier else exp.env_params
         traces = env_lib.stack_traces(windows, params)
 
     report: dict[str, Any] = {}
-    res = replay(exp.apply_fn, exp.train_state.params, exp.env_params,
-                 traces, max_steps)
+    pcts: dict[str, dict[str, float]] = {}
+
+    def pct_row(jcts: np.ndarray) -> dict[str, float]:
+        return {f"p{g:g}": float(np.percentile(jcts, g))
+                for g in percentiles} if jcts.size else {}
+
+    res, states = replay(exp.apply_fn, exp.train_state.params,
+                         exp.env_params, traces, max_steps,
+                         return_states=True)
     report["policy"], report["policy_completion"] = pooled_avg_jct(res)
     report["policy_utilization"] = float(np.mean(np.asarray(res.utilization)))
+    if percentiles is not None:
+        # a truncated replay (max_steps cut) drops exactly the LONGEST
+        # jobs, so its tail percentiles would read better than the
+        # baselines' full-completion tails — same survivor-bias class
+        # fairness_report guards against. No row rather than a wrong row.
+        pcts["policy"] = (pct_row(_replay_jcts(states, traces))
+                          if report["policy_completion"] >= 1.0 else {})
     if include_random:
-        rnd = replay(exp.apply_fn, exp.train_state.params, exp.env_params,
-                     traces, max_steps, policy="random",
-                     key=jax.random.PRNGKey(1))
-        report["random"], _ = pooled_avg_jct(rnd)
-    report.update(baseline_jct_table(
-        windows, exp.cfg.n_nodes, exp.cfg.gpus_per_node, baselines))
+        rnd, rnd_states = replay(exp.apply_fn, exp.train_state.params,
+                                 exp.env_params, traces, max_steps,
+                                 policy="random", key=jax.random.PRNGKey(1),
+                                 return_states=True)
+        report["random"], rnd_completion = pooled_avg_jct(rnd)
+        if percentiles is not None:
+            pcts["random"] = (pct_row(_replay_jcts(rnd_states, traces))
+                              if rnd_completion >= 1.0 else {})
+    for name in baselines:
+        jcts = baseline_jcts(windows, exp.cfg.n_nodes,
+                             exp.cfg.gpus_per_node, name)
+        report[name] = float(np.mean(jcts)) if jcts.size else 0.0
+        if percentiles is not None:
+            pcts[name] = pct_row(jcts)
     if "tiresias" in report and report["tiresias"] > 0:
         report["vs_tiresias"] = report["policy"] / report["tiresias"]
+    if percentiles is not None:
+        report["percentiles"] = pcts
     return report
 
 
@@ -557,11 +603,22 @@ def format_report(report: dict[str, Any]) -> str:
             if isinstance(v, float) and k not in
             ("vs_tiresias", "policy_completion", "policy_utilization")]
     rows.sort(key=lambda kv: kv[1])
-    width = max(len(k) for k, _ in rows)
+    width = max(len("scheduler"), *(len(k) for k, _ in rows))
     lines = [f"{'scheduler':<{width}}  avg JCT (s)",
              f"{'-' * width}  -----------"]
     for k, v in rows:
         lines.append(f"{k:<{width}}  {v:>11.1f}")
+    if "percentiles" in report:
+        cols = sorted({c for row in report["percentiles"].values()
+                       for c in row},
+                      key=lambda c: float(c[1:]))
+        lines.append(f"{'':<{width}}  " +
+                     "  ".join(f"{c:>9}" for c in cols))
+        for k, _ in rows:
+            row = report["percentiles"].get(k, {})
+            lines.append(f"{k:<{width}}  " + "  ".join(
+                f"{row[c]:>9.1f}" if c in row else f"{'—':>9}"
+                for c in cols))
     if "vs_tiresias" in report:
         lines.append(f"policy/tiresias ratio: {report['vs_tiresias']:.3f} "
                      f"(<1 beats Tiresias)")

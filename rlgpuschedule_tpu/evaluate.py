@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "dir (omit = untrained init weights)")
     p.add_argument("--ckpt-step", type=int, default=None)
     p.add_argument("--max-steps", type=int, default=None)
+    p.add_argument("--percentiles", action="store_true",
+                   help="add p50/p90/p99 JCT tail-latency columns per "
+                        "scheduler to the table (flat configs)")
     p.add_argument("--pbt", action="store_true",
                    help="evaluate a PBT population checkpoint (config 5): "
                         "restores the population from --ckpt-dir and "
@@ -98,6 +101,12 @@ def main(argv: list[str] | None = None) -> dict:
         print(json.dumps(report))
         return report
 
+    if args.percentiles and (args.full_trace or args.fairness
+                             or args.baselines_only or args.pbt):
+        sys.exit("--percentiles applies to the plain per-window JCT table "
+                 "(flat configs, no --full-trace/--fairness/"
+                 "--baselines-only/--pbt)")
+
     def restore(target, label: str) -> None:
         if args.ckpt_dir:
             from .checkpoint import Checkpointer
@@ -148,10 +157,14 @@ def main(argv: list[str] | None = None) -> dict:
                                    include_random=not args.no_random)
     else:
         report = jct_report(exp, max_steps=args.max_steps,
-                            include_random=not args.no_random)
+                            include_random=not args.no_random,
+                            percentiles=(50, 90, 99) if args.percentiles
+                            else None)
     print(format_report(report), file=sys.stderr)
-    print(json.dumps({k: v for k, v in report.items()
-                      if isinstance(v, (int, float))}))
+    out = {k: v for k, v in report.items() if isinstance(v, (int, float))}
+    if "percentiles" in report:
+        out["percentiles"] = report["percentiles"]
+    print(json.dumps(out))
     return report
 
 

@@ -94,6 +94,41 @@ class TestJctTable:
                                      baselines=("fifo",))
         assert "fifo" in report and "random" not in report
 
+    def test_percentile_columns(self, exp, windows):
+        """p50/p90/p99 tail columns (SURVEY.md §2 "avg/percentile JCT"):
+        baseline percentiles must equal np.percentile over the oracle's
+        own pooled per-job JCTs, and every completed row's p50 <= p99."""
+        report = eval_lib.jct_report(exp, windows=windows,
+                                     include_random=False,
+                                     baselines=("fifo",),
+                                     percentiles=(50, 99))
+        pct = report["percentiles"]
+        assert set(pct) == {"policy", "fifo"}
+        jcts = eval_lib.baseline_jcts(windows, exp.cfg.n_nodes,
+                                      exp.cfg.gpus_per_node, "fifo")
+        assert pct["fifo"]["p50"] == pytest.approx(
+            np.percentile(jcts, 50), rel=1e-9)
+        assert pct["fifo"]["p99"] == pytest.approx(
+            np.percentile(jcts, 99), rel=1e-9)
+        for row in pct.values():
+            assert row["p50"] <= row["p99"]
+        # policy pooled mean must equal the report's avg (same jobs)
+        text = eval_lib.format_report(report)
+        assert "p99" in text
+
+    def test_percentiles_guard_truncated_replay(self, exp, windows):
+        """A max_steps-truncated replay drops the longest jobs, which
+        would flatter the policy's tail columns — the row must be empty,
+        not silently survivor-biased (baselines always complete)."""
+        report = eval_lib.jct_report(exp, windows=windows,
+                                     include_random=False,
+                                     baselines=("fifo",),
+                                     percentiles=(50, 99), max_steps=4)
+        assert report["policy_completion"] < 1.0
+        assert report["percentiles"]["policy"] == {}
+        assert report["percentiles"]["fifo"]  # baselines still reported
+        assert "—" in eval_lib.format_report(report)
+
 
 class TestFairnessReport:
     def test_tenant_table_and_jain(self):
