@@ -47,6 +47,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "dir (omit = untrained init weights)")
     p.add_argument("--ckpt-step", type=int, default=None)
     p.add_argument("--max-steps", type=int, default=None)
+    p.add_argument("--eval-windows", type=int, default=None,
+                   help="evaluate on this many windows instead of --n-envs. "
+                        "--n-envs must still match the TRAINING run (the "
+                        "checkpoint's rollout carry restores into it), but "
+                        "the replay itself has no batch-size constraint — "
+                        "use a small value to evaluate a large-batch TPU "
+                        "checkpoint on a CPU host")
     p.add_argument("--percentiles", action="store_true",
                    help="add p50/p90/p99 JCT tail-latency columns per "
                         "scheduler to the table (flat configs)")
@@ -106,6 +113,12 @@ def main(argv: list[str] | None = None) -> dict:
         sys.exit("--percentiles applies to the plain per-window JCT table "
                  "(flat configs, no --full-trace/--fairness/"
                  "--baselines-only/--pbt)")
+    if args.eval_windows is not None and (args.pbt or args.fairness or
+                                          args.full_trace or
+                                          args.baselines_only):
+        sys.exit("--eval-windows applies to the plain per-window JCT "
+                 "table (population views carry no source trace; the "
+                 "other modes define their own window batch)")
 
     def restore(target, label: str) -> None:
         if args.ckpt_dir:
@@ -156,7 +169,21 @@ def main(argv: list[str] | None = None) -> dict:
         report = full_trace_report(exp, max_jobs=args.max_jobs,
                                    include_random=not args.no_random)
     else:
-        report = jct_report(exp, max_steps=args.max_steps,
+        eval_windows = None
+        if args.eval_windows is not None and \
+                args.eval_windows != cfg.n_envs:
+            # re-cut the evaluation window batch at the requested size,
+            # keeping the checkpoint's restored tiling cursor so a
+            # resized batch replays the same part of the trace the
+            # default path would; the restored params have no batch
+            # dimension, so only the restore template above needed the
+            # training n_envs
+            from .experiment import make_env_windows
+            eval_windows = make_env_windows(
+                dataclasses.replace(cfg, n_envs=args.eval_windows),
+                exp.source, start=exp.window_cursor)
+        report = jct_report(exp, windows=eval_windows,
+                            max_steps=args.max_steps,
                             include_random=not args.no_random,
                             percentiles=(50, 90, 99) if args.percentiles
                             else None)

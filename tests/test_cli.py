@@ -160,6 +160,24 @@ class TestEvaluateCLI:
         assert np.isfinite(drain["policy"])
         assert drain["fifo"] != stream["fifo"]
 
+    def test_eval_windows_decoupled_from_training_batch(self, tmp_path):
+        # a checkpoint trained at n_envs=4 must evaluate on a 2-window
+        # batch: --n-envs stays 4 (the carry restore template), while
+        # --eval-windows re-cuts the replay batch (the big-batch-TPU-
+        # checkpoint-on-CPU-host case)
+        ckpt_dir = str(tmp_path / "ckpt")
+        train_cli.main(["--config", "ppo-mlp-synth64", *FAST,
+                        "--ckpt-dir", ckpt_dir, "--ckpt-every", "2"])
+        report = evaluate_cli.main(
+            ["--config", "ppo-mlp-synth64", "--n-envs", "4",
+             "--n-nodes", "2", "--gpus-per-node", "4",
+             "--window-jobs", "16", "--horizon", "64", "--max-steps", "64",
+             "--no-random", "--ckpt-dir", ckpt_dir, "--eval-windows", "2"])
+        assert np.isfinite(report["policy"])
+        with pytest.raises(SystemExit):
+            evaluate_cli.main(["--config", "hier-pbt-member", "--pbt",
+                               "--eval-windows", "2"])
+
     def test_pbt_population_eval(self, tmp_path):
         # config-5 eval path: train a tiny PBT population, checkpoint it,
         # then restore + replay the fittest member against the baselines
