@@ -333,17 +333,20 @@ def pooled_avg_jct(result: EvalResult) -> tuple[float, float]:
     return float((jct * n).sum() / max(total, 1.0)), frac
 
 
+def _pct_row(jcts: np.ndarray,
+             percentiles: tuple[float, ...]) -> dict[str, float]:
+    """One scheduler's tail-latency columns, e.g. {"p50": .., "p99": ..}."""
+    return {f"p{g:g}": float(np.percentile(jcts, g))
+            for g in percentiles} if jcts.size else {}
+
+
 def baseline_jcts(windows: list[ArrayTrace], n_nodes: int,
                   gpus_per_node: int, name: str) -> np.ndarray:
     """Pooled per-job JCTs of one baseline over the windows (completed
     valid jobs only) — the array behind both the mean and the percentile
     columns."""
-    jcts = []
-    for w in windows:
-        sim = run_baseline(w, n_nodes, gpus_per_node, name)
-        finish = np.asarray(sim.finish, np.float64)
-        done = np.asarray(w.valid) & np.isfinite(finish)
-        jcts.append(finish[done] - np.asarray(w.submit, np.float64)[done])
+    jcts = [run_baseline(w, n_nodes, gpus_per_node, name).jcts()
+            for w in windows]
     return np.concatenate(jcts) if jcts else np.zeros(0)
 
 
@@ -404,11 +407,6 @@ def jct_report(exp, windows: list[ArrayTrace] | None = None,
 
     report: dict[str, Any] = {}
     pcts: dict[str, dict[str, float]] = {}
-
-    def pct_row(jcts: np.ndarray) -> dict[str, float]:
-        return {f"p{g:g}": float(np.percentile(jcts, g))
-                for g in percentiles} if jcts.size else {}
-
     res, states = replay(exp.apply_fn, exp.train_state.params,
                          exp.env_params, traces, max_steps,
                          return_states=True)
@@ -419,7 +417,7 @@ def jct_report(exp, windows: list[ArrayTrace] | None = None,
         # jobs, so its tail percentiles would read better than the
         # baselines' full-completion tails — same survivor-bias class
         # fairness_report guards against. No row rather than a wrong row.
-        pcts["policy"] = (pct_row(_replay_jcts(states, traces))
+        pcts["policy"] = (_pct_row(_replay_jcts(states, traces), percentiles)
                           if report["policy_completion"] >= 1.0 else {})
     if include_random:
         rnd, rnd_states = replay(exp.apply_fn, exp.train_state.params,
@@ -428,14 +426,15 @@ def jct_report(exp, windows: list[ArrayTrace] | None = None,
                                  return_states=True)
         report["random"], rnd_completion = pooled_avg_jct(rnd)
         if percentiles is not None:
-            pcts["random"] = (pct_row(_replay_jcts(rnd_states, traces))
+            pcts["random"] = (_pct_row(_replay_jcts(rnd_states, traces),
+                                       percentiles)
                               if rnd_completion >= 1.0 else {})
     for name in baselines:
         jcts = baseline_jcts(windows, exp.cfg.n_nodes,
                              exp.cfg.gpus_per_node, name)
         report[name] = float(np.mean(jcts)) if jcts.size else 0.0
         if percentiles is not None:
-            pcts[name] = pct_row(jcts)
+            pcts[name] = _pct_row(jcts, percentiles)
     if "tiresias" in report and report["tiresias"] > 0:
         report["vs_tiresias"] = report["policy"] / report["tiresias"]
     if percentiles is not None:
@@ -447,7 +446,9 @@ def full_trace_report(exp, max_jobs: int | None = None,
                       baselines: tuple[str, ...] = ("fifo", "sjf", "srtf",
                                                     "tiresias"),
                       max_steps_per_window: int | None = None,
-                      include_random: bool = True) -> dict[str, Any]:
+                      include_random: bool = True,
+                      percentiles: tuple[float, ...] | None = None,
+                      ) -> dict[str, Any]:
     """The FULL-trace comparison table (``evaluate --full-trace``): policy
     avg-JCT via :func:`full_trace_replay` vs the baselines run by the
     native C++ engine (oracle fallback) over the exact same source trace —
@@ -461,23 +462,35 @@ def full_trace_report(exp, max_jobs: int | None = None,
     source = exp.source
     if max_jobs is not None and source.num_jobs > max_jobs:
         source = source.slice(0, max_jobs)
+    pcts: dict[str, dict[str, float]] = {}
     out = full_trace_replay(exp.apply_fn, exp.train_state.params,
                             exp.env_params, source,
                             max_steps_per_window=max_steps_per_window)
     report: dict[str, Any] = {"policy": out["avg_jct"],
                               "n_jobs": out["n_jobs"],
                               "policy_windows": out["windows"]}
+    if percentiles is not None:
+        # full_trace_replay asserts every job finished, so unlike the
+        # per-window harness there is no truncation bias to guard
+        pcts["policy"] = _pct_row(out["jct"], percentiles)
     if include_random:
         rnd = full_trace_replay(exp.apply_fn, exp.train_state.params,
                                 exp.env_params, source,
                                 max_steps_per_window=max_steps_per_window,
                                 policy="random", key=jax.random.PRNGKey(1))
         report["random"] = rnd["avg_jct"]
+        if percentiles is not None:
+            pcts["random"] = _pct_row(rnd["jct"], percentiles)
     for name in baselines:
-        report[name] = run_baseline(source, exp.cfg.n_nodes,
-                                    exp.cfg.gpus_per_node, name).avg_jct()
+        sim = run_baseline(source, exp.cfg.n_nodes, exp.cfg.gpus_per_node,
+                           name)
+        report[name] = sim.avg_jct()
+        if percentiles is not None:
+            pcts[name] = _pct_row(sim.jcts(), percentiles)
     if report.get("tiresias"):
         report["vs_tiresias"] = report["policy"] / report["tiresias"]
+    if percentiles is not None:
+        report["percentiles"] = pcts
     return report
 
 

@@ -21,6 +21,9 @@ import dataclasses
 import json
 import sys
 
+# tail-latency columns --percentiles adds (keep the flag's help in sync)
+PERCENTILES = (50, 90, 99)
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -101,17 +104,10 @@ def main(argv: list[str] | None = None) -> dict:
                        format_report, full_trace_report, jct_report)
     from .experiment import Experiment, build_stack
 
-    if args.baselines_only:
-        _, windows, _, _, _, _, _ = build_stack(cfg)
-        report = baseline_jct_table(windows, cfg.n_nodes, cfg.gpus_per_node)
-        print(format_report(report), file=sys.stderr)
-        print(json.dumps(report))
-        return report
-
-    if args.percentiles and (args.full_trace or args.fairness
-                             or args.baselines_only or args.pbt):
-        sys.exit("--percentiles applies to the plain per-window JCT table "
-                 "(flat configs, no --full-trace/--fairness/"
+    if args.percentiles and (args.fairness or args.baselines_only
+                             or args.pbt):
+        sys.exit("--percentiles applies to the per-window and --full-trace "
+                 "JCT tables (flat configs, no --fairness/"
                  "--baselines-only/--pbt)")
     if args.eval_windows is not None and (args.pbt or args.fairness or
                                           args.full_trace or
@@ -119,6 +115,13 @@ def main(argv: list[str] | None = None) -> dict:
         sys.exit("--eval-windows applies to the plain per-window JCT "
                  "table (population views carry no source trace; the "
                  "other modes define their own window batch)")
+
+    if args.baselines_only:
+        _, windows, _, _, _, _, _ = build_stack(cfg)
+        report = baseline_jct_table(windows, cfg.n_nodes, cfg.gpus_per_node)
+        print(format_report(report), file=sys.stderr)
+        print(json.dumps(report))
+        return report
 
     def restore(target, label: str) -> None:
         if args.ckpt_dir:
@@ -167,7 +170,9 @@ def main(argv: list[str] | None = None) -> dict:
         return report
     if args.full_trace:
         report = full_trace_report(exp, max_jobs=args.max_jobs,
-                                   include_random=not args.no_random)
+                                   include_random=not args.no_random,
+                                   percentiles=PERCENTILES
+                                   if args.percentiles else None)
     else:
         eval_windows = None
         if args.eval_windows is not None and \
@@ -185,7 +190,7 @@ def main(argv: list[str] | None = None) -> dict:
         report = jct_report(exp, windows=eval_windows,
                             max_steps=args.max_steps,
                             include_random=not args.no_random,
-                            percentiles=(50, 90, 99) if args.percentiles
+                            percentiles=PERCENTILES if args.percentiles
                             else None)
     print(format_report(report), file=sys.stderr)
     out = {k: v for k, v in report.items() if isinstance(v, (int, float))}

@@ -219,11 +219,27 @@ class TestFullTraceReplay:
     def test_full_trace_report_table(self):
         cfg = dataclasses.replace(small_cfg(), window_jobs=16)
         exp = Experiment.build(cfg)
-        report = eval_lib.full_trace_report(exp, max_jobs=60)
+        report = eval_lib.full_trace_report(exp, max_jobs=60,
+                                            percentiles=(50, 99))
         for k in ("policy", "random", "fifo", "sjf", "srtf", "tiresias",
                   "vs_tiresias"):
             assert k in report and np.isfinite(report[k])
         assert report["n_jobs"] == 60
+        pct = report["percentiles"]
+        assert set(pct) == {"policy", "random", "fifo", "sjf", "srtf",
+                            "tiresias"}
+        for row in pct.values():
+            assert 0 < row["p50"] <= row["p99"]
+        # baseline percentile must equal np.percentile over the oracle's
+        # own per-job JCTs on the same sliced trace
+        sliced = exp.source.slice(0, 60)
+        sim = eval_lib.run_baseline(sliced, cfg.n_nodes, cfg.gpus_per_node,
+                                    "fifo")
+        finish = np.asarray(sim.finish, np.float64)
+        done = np.asarray(sliced.valid) & np.isfinite(finish)
+        ref = finish[done] - np.asarray(sliced.submit, np.float64)[done]
+        assert pct["fifo"]["p50"] == pytest.approx(
+            np.percentile(ref, 50), rel=1e-6)
 
     @staticmethod
     def _fifo_apply(_params, obs, mask):
