@@ -286,11 +286,21 @@ class Experiment:
 
     def run(self, iterations: int | None = None, log_every: int = 0,
             logger: Callable[[int, dict], None] | None = None,
-            ckpt=None, ckpt_every: int = 0) -> dict:
+            ckpt=None, ckpt_every: int = 0,
+            eval_every: int = 0,
+            eval_fn: "Callable[[int], dict] | None" = None,
+            eval_logger: Callable[[int, dict], None] | None = None) -> dict:
         """Run the host training loop; returns summary metrics. Pass a
-        ``checkpoint.Checkpointer`` + cadence to persist while training."""
+        ``checkpoint.Checkpointer`` + cadence to persist while training.
+
+        ``eval_fn(i) -> dict`` runs every ``eval_every`` iterations (and at
+        the last one) — the in-training quality probe (e.g. a held-out JCT
+        replay); its rows go to ``eval_logger`` (NOT ``logger``: eval rows
+        have a different schema than train rows and MetricsLogger pins one
+        schema per stream) and into the summary's ``eval_history``."""
         iterations = iterations or self.cfg.iterations
         history = []
+        eval_history = []
         t0 = time.time()
         for i in range(iterations):
             self.key, sub = jax.random.split(self.key)
@@ -301,6 +311,12 @@ class Experiment:
                 history.append({"iteration": i, **m})
                 if logger is not None:
                     logger(i, m)
+            if eval_fn is not None and eval_every and \
+                    ((i + 1) % eval_every == 0 or i == iterations - 1):
+                em = dict(eval_fn(i))
+                eval_history.append({"iteration": i, **em})
+                if eval_logger is not None:
+                    eval_logger(i, em)
             if ckpt is not None and ckpt_every and \
                     ((i + 1) % ckpt_every == 0 or i == iterations - 1):
                 self.save_checkpoint(ckpt, meta={"iteration": i})
@@ -311,11 +327,14 @@ class Experiment:
         jax.block_until_ready(self.train_state.params)
         wall = time.time() - t0
         total_env_steps = iterations * self.steps_per_iteration
-        return {"wall_s": wall, "iterations": iterations,
-                "env_steps": total_env_steps,
-                "env_steps_per_sec": total_env_steps / wall,
-                "window_cursor": self.window_cursor,
-                "history": history}
+        out = {"wall_s": wall, "iterations": iterations,
+               "env_steps": total_env_steps,
+               "env_steps_per_sec": total_env_steps / wall,
+               "window_cursor": self.window_cursor,
+               "history": history}
+        if eval_history:
+            out["eval_history"] = eval_history
+        return out
 
 
 @dataclasses.dataclass

@@ -78,6 +78,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="iterations between exploit/explore rounds")
     # logging / checkpointing / profiling
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="every N iterations, replay the policy greedily on "
+                        "a small HELD-OUT window batch and log avg JCT + "
+                        "vs_tiresias (the in-training quality probe; "
+                        "single-run configs). Rows go to <log-csv>.eval.csv")
+    p.add_argument("--eval-windows", type=int, default=4,
+                   help="held-out windows per --eval-every probe")
+    p.add_argument("--eval-seed", type=int, default=None,
+                   help="seed of the held-out eval trace (default: "
+                        "training seed + 1000)")
     p.add_argument("--log-csv", default=None)
     p.add_argument("--tb-dir", default=None,
                    help="also write scalar curves as a TensorBoard event "
@@ -129,6 +139,64 @@ def apply_overrides(cfg: ExperimentConfig,
     return cfg
 
 
+def make_eval_probe(cfg: ExperimentConfig, exp, n_windows: int,
+                    eval_seed: int | None):
+    """The --eval-every in-training quality probe: a greedy replay on a
+    held-out window batch (fresh trace seed, so never trained on), scored
+    against oracle baselines computed ONCE. Returns ``eval_fn(i) -> dict``
+    for :meth:`Experiment.run`. The replay program compiles on the first
+    probe and is reused after (fixed shapes)."""
+    from . import eval as eval_lib
+    from .env import env as env_lib
+    from .experiment import load_source_trace, make_env_windows
+    from .sim.core import validate_trace
+
+    import sys
+
+    if cfg.trace in ("philly", "pai"):
+        # CSV loaders take no seed: there is no second trace to hold out,
+        # so the probe replays leading windows of the TRAINING csv —
+        # on-distribution, not held-out. Refuse a seed that would
+        # otherwise be a silent no-op, and say what the number means.
+        if eval_seed is not None:
+            sys.exit("--eval-seed has no effect for csv traces "
+                     "(philly/pai load a file, not a seeded generator)")
+        print("note: --eval-every probe windows come from the training "
+              "CSV (csv traces have no held-out seed); treat the curve "
+              "as on-distribution quality, not generalization",
+              file=sys.stderr)
+    seed = cfg.seed + 1000 if eval_seed is None else eval_seed
+    # probe one regime, not a mix: drain-curriculum configs are scored on
+    # the drain tables (BASELINE.md), so probe all-drain; otherwise all
+    # streaming. A fractional drain_frac would pool two incomparable
+    # regimes into one number.
+    ecfg = dataclasses.replace(cfg, n_envs=n_windows, seed=seed,
+                               drain_frac=1.0 if cfg.drain_frac > 0
+                               else 0.0)
+    sim_params = (exp.env_params.sim
+                  if hasattr(exp.env_params, "sim") else
+                  exp.env_params.pod_sim)
+    source = validate_trace(sim_params, load_source_trace(ecfg),
+                            clamp=True)
+    windows = make_env_windows(ecfg, source)
+    traces = env_lib.stack_traces(windows, sim_params)
+    baselines = eval_lib.baseline_jct_table(
+        windows, cfg.n_nodes, cfg.gpus_per_node,
+        names=("fifo", "tiresias"))
+
+    def eval_fn(_i: int) -> dict:
+        res = eval_lib.replay(exp.apply_fn, exp.train_state.params,
+                              exp.env_params, traces)
+        jct, completion = eval_lib.pooled_avg_jct(res)
+        out = {"eval_avg_jct": jct, "eval_completion": completion,
+               **{f"eval_{k}": v for k, v in baselines.items()}}
+        if baselines.get("tiresias"):
+            out["eval_vs_tiresias"] = jct / baselines["tiresias"]
+        return out
+
+    return eval_fn
+
+
 def make_pop_mesh(n_pop: int):
     """Best (pop, data) mesh for the available devices: the largest pop
     axis that divides both the population and the device count (1 device →
@@ -156,6 +224,11 @@ def main(argv: list[str] | None = None) -> dict:
         return {}
     if args.config not in CONFIGS:
         sys.exit(f"unknown config {args.config!r}; try --list-configs")
+    if args.eval_every and args.pbt:
+        # validate before the population build: compiling an 8-member
+        # population just to reject a flag combination wastes minutes
+        sys.exit("--eval-every applies to single-run configs; evaluate "
+                 "PBT members post-hoc with `evaluate --pbt`")
     cfg = apply_overrides(CONFIGS[args.config], args)
 
     import contextlib
@@ -203,8 +276,18 @@ def main(argv: list[str] | None = None) -> dict:
             print(f"resumed from step {ckpt.latest_step()} ({meta})",
                   file=sys.stderr)
 
+        eval_kw = {}
+        if args.eval_every:
+            eval_kw = dict(
+                eval_every=args.eval_every,
+                eval_fn=make_eval_probe(cfg, exp, args.eval_windows,
+                                        args.eval_seed),
+                eval_logger=stack.enter_context(
+                    MetricsLogger(args.log_csv + ".eval.csv"
+                                  if args.log_csv else None, echo=True)))
+
         out = exp.run(log_every=args.log_every, logger=logger,
-                      ckpt=ckpt, ckpt_every=args.ckpt_every)
+                      ckpt=ckpt, ckpt_every=args.ckpt_every, **eval_kw)
 
         summary = {k: v for k, v in out.items() if k != "history"}
         if args.report and not args.pbt and cfg.n_pods == 1:

@@ -127,6 +127,23 @@ class TestTrainCLI:
         with pytest.raises(SystemExit):
             train_cli.apply_overrides(CONFIGS["a2c-pai-fair"], bad)
 
+    def test_eval_every_probe(self, tmp_path):
+        # --eval-every: held-out greedy replay scored vs cached baselines,
+        # logged to a separate .eval.csv stream (schemas differ from the
+        # train rows) and returned as eval_history
+        csv_path = str(tmp_path / "m.csv")
+        summary = train_cli.main(
+            ["--config", "ppo-mlp-synth64", *FAST, "--eval-every", "1",
+             "--eval-windows", "2", "--log-csv", csv_path])
+        hist = summary["eval_history"]
+        assert len(hist) == 2        # iterations=2, probe each iteration
+        for row in hist:
+            assert np.isfinite(row["eval_avg_jct"])
+            assert np.isfinite(row["eval_vs_tiresias"])
+            assert 0 < row["eval_completion"] <= 1.0
+        rows = list(csv.DictReader(open(csv_path + ".eval.csv")))
+        assert len(rows) == 2 and "eval_vs_tiresias" in rows[0]
+
     def test_report_flag(self, capsys):
         summary = train_cli.main(
             ["--config", "ppo-mlp-synth64", *FAST, "--report"])
