@@ -133,6 +133,17 @@ class Checkpointer:
         return state, tree.get("key"), tree.get("extra"), dict(
             restored["meta"] or {})
 
+    def read_meta(self, step: int | None = None) -> dict:
+        """Read a checkpoint's JSON meta without restoring its arrays
+        (e.g. the best-checkpoint bar a resumed --keep-best run recovers)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        restored = self._mngr.restore(
+            step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
+        return dict(restored["meta"] or {})
+
     def wait(self) -> None:
         """Block until async saves are durable (call before reading the
         files from another process, e.g. a PBT exploit copy)."""

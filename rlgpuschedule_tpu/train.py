@@ -88,6 +88,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval-seed", type=int, default=None,
                    help="seed of the held-out eval trace (default: "
                         "training seed + 1000)")
+    p.add_argument("--keep-best", action="store_true",
+                   help="with --eval-every and --ckpt-dir: whenever the "
+                        "held-out probe's avg JCT improves (at full "
+                        "completion), save a checkpoint under "
+                        "<ckpt-dir>/best — automated model selection "
+                        "against late-training collapse")
     p.add_argument("--log-csv", default=None)
     p.add_argument("--tb-dir", default=None,
                    help="also write scalar curves as a TensorBoard event "
@@ -229,6 +235,9 @@ def main(argv: list[str] | None = None) -> dict:
         # population just to reject a flag combination wastes minutes
         sys.exit("--eval-every applies to single-run configs; evaluate "
                  "PBT members post-hoc with `evaluate --pbt`")
+    if args.keep_best and not (args.eval_every and args.ckpt_dir):
+        sys.exit("--keep-best requires --eval-every (the probe that "
+                 "defines 'best') and --ckpt-dir (where best/ lives)")
     cfg = apply_overrides(CONFIGS[args.config], args)
 
     import contextlib
@@ -278,10 +287,43 @@ def main(argv: list[str] | None = None) -> dict:
 
         eval_kw = {}
         if args.eval_every:
+            probe = make_eval_probe(cfg, exp, args.eval_windows,
+                                    args.eval_seed)
+            if args.keep_best:
+                from .checkpoint import Checkpointer
+                import os
+                best_ckpt = stack.enter_context(Checkpointer(
+                    os.path.join(os.path.abspath(args.ckpt_dir), "best"),
+                    max_to_keep=1))
+                best = {"jct": float("inf")}
+                if best_ckpt.latest_step() is not None:
+                    # a resumed run must not rotate out a prior run's
+                    # genuinely-best checkpoint with its own first probe:
+                    # recover the bar from the saved meta
+                    best["jct"] = float(best_ckpt.read_meta().get(
+                        "eval_avg_jct", float("inf")))
+                    print(f"keep-best: prior best eval_avg_jct="
+                          f"{best['jct']:.1f}", file=sys.stderr)
+
+                def probe(i, _inner=probe):
+                    m = dict(_inner(i))
+                    improved = (m["eval_completion"] >= 1.0 and
+                                m["eval_avg_jct"] < best["jct"])
+                    if improved:
+                        # force: a resumed run can revisit a step number
+                        # the best dir already holds; a silently-skipped
+                        # save would leave stale params labeled with the
+                        # new probe result
+                        exp.save_checkpoint(
+                            best_ckpt,
+                            meta={"iteration": i,
+                                  "eval_avg_jct": m["eval_avg_jct"]},
+                            force=True)
+                        best["jct"] = m["eval_avg_jct"]
+                    m["eval_is_best"] = float(improved)
+                    return m
             eval_kw = dict(
-                eval_every=args.eval_every,
-                eval_fn=make_eval_probe(cfg, exp, args.eval_windows,
-                                        args.eval_seed),
+                eval_every=args.eval_every, eval_fn=probe,
                 eval_logger=stack.enter_context(
                     MetricsLogger(args.log_csv + ".eval.csv"
                                   if args.log_csv else None, echo=True)))

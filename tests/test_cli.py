@@ -144,6 +144,37 @@ class TestTrainCLI:
         rows = list(csv.DictReader(open(csv_path + ".eval.csv")))
         assert len(rows) == 2 and "eval_vs_tiresias" in rows[0]
 
+    def test_keep_best_checkpoint(self, tmp_path):
+        # --keep-best: the best-by-held-out-probe params survive under
+        # <ckpt-dir>/best even if later iterations regress (the GNN
+        # late-collapse lesson); the eval rows carry an eval_is_best flag
+        ckpt_dir = str(tmp_path / "ckpt")
+        summary = train_cli.main(
+            ["--config", "ppo-mlp-synth64", *FAST, "--eval-every", "1",
+             "--eval-windows", "2", "--ckpt-dir", ckpt_dir,
+             "--keep-best"])
+        hist = summary["eval_history"]
+        assert hist[0]["eval_is_best"] == 1.0   # first probe always best
+        from rlgpuschedule_tpu.checkpoint import Checkpointer
+        with Checkpointer(os.path.join(ckpt_dir, "best")) as best:
+            assert len(best.all_steps()) == 1
+        best_jcts = [r["eval_avg_jct"] for r in hist
+                     if r["eval_is_best"] == 1.0]
+        # keep-best only tracks full-completion probes (its contract)
+        assert min(r["eval_avg_jct"] for r in hist
+                   if r["eval_completion"] >= 1.0) == best_jcts[-1]
+        # a resumed run recovers the bar from the best meta instead of
+        # resetting to +inf (which would rotate out the prior best)
+        with Checkpointer(os.path.join(ckpt_dir, "best")) as best:
+            prior = best.read_meta()["eval_avg_jct"]
+        summary2 = train_cli.main(
+            ["--config", "ppo-mlp-synth64", *FAST, "--eval-every", "1",
+             "--eval-windows", "2", "--ckpt-dir", ckpt_dir,
+             "--keep-best", "--resume"])
+        for row in summary2["eval_history"]:
+            if row["eval_is_best"] == 1.0:
+                assert row["eval_avg_jct"] < prior
+
     def test_report_flag(self, capsys):
         summary = train_cli.main(
             ["--config", "ppo-mlp-synth64", *FAST, "--report"])
