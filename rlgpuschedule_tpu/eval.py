@@ -448,6 +448,7 @@ def full_trace_report(exp, max_jobs: int | None = None,
                       max_steps_per_window: int | None = None,
                       include_random: bool = True,
                       percentiles: tuple[float, ...] | None = None,
+                      env_params: EnvParams | None = None,
                       ) -> dict[str, Any]:
     """The FULL-trace comparison table (``evaluate --full-trace``): policy
     avg-JCT via :func:`full_trace_replay` vs the baselines run by the
@@ -455,16 +456,39 @@ def full_trace_report(exp, max_jobs: int | None = None,
     the apples-to-apples full-Philly comparison north-star #2 demands.
     ``include_random`` adds a masked-uniform-policy row through the same
     windowed-replay machinery (the learning-smoke yardstick: the trained
-    policy must decisively beat it)."""
-    if isinstance(exp.env_params, HierParams):
+    policy must decisively beat it).
+
+    ``env_params`` overrides the stitch-replay environment — in particular
+    its ``sim.max_jobs`` stitch-window size. The policy nets are
+    max_jobs-independent (observations are functions of the cluster and
+    the queue view, not the job-table size), so a checkpoint trained at
+    one window size can replay through a DEEPER stitched window, widening
+    the backlog the stitcher holds between seams; the cluster shape and
+    queue_len must still match the checkpoint."""
+    eval_params = env_params or exp.env_params
+    if isinstance(exp.env_params, HierParams) or \
+            isinstance(eval_params, HierParams):
         raise ValueError("full-trace evaluation supports flat configs; "
                          "hierarchical pods replay per-window (jct_report)")
+    if env_params is not None:
+        # enforce the whole contract, not just sim geometry: time_scale /
+        # obs_kind / reward bins are baked into the checkpointed policy's
+        # observation semantics too — only the stitch window may differ
+        normalized = dataclasses.replace(
+            eval_params, sim=dataclasses.replace(
+                eval_params.sim, max_jobs=exp.env_params.sim.max_jobs),
+            horizon=exp.env_params.horizon)
+        if normalized != exp.env_params:
+            raise ValueError(
+                "env_params may change the stitch window (sim.max_jobs) "
+                "and horizon only; every other field is baked into the "
+                "checkpointed policy's observation and action spaces")
     source = exp.source
     if max_jobs is not None and source.num_jobs > max_jobs:
         source = source.slice(0, max_jobs)
     pcts: dict[str, dict[str, float]] = {}
     out = full_trace_replay(exp.apply_fn, exp.train_state.params,
-                            exp.env_params, source,
+                            eval_params, source,
                             max_steps_per_window=max_steps_per_window)
     report: dict[str, Any] = {"policy": out["avg_jct"],
                               "n_jobs": out["n_jobs"],
@@ -475,7 +499,7 @@ def full_trace_report(exp, max_jobs: int | None = None,
         pcts["policy"] = _pct_row(out["jct"], percentiles)
     if include_random:
         rnd = full_trace_replay(exp.apply_fn, exp.train_state.params,
-                                exp.env_params, source,
+                                eval_params, source,
                                 max_steps_per_window=max_steps_per_window,
                                 policy="random", key=jax.random.PRNGKey(1))
         report["random"] = rnd["avg_jct"]

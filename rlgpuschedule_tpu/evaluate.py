@@ -82,6 +82,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-jobs", type=int, default=None,
                    help="with --full-trace: cap the source trace at the "
                         "first N jobs")
+    p.add_argument("--stitch-window-jobs", type=int, default=None,
+                   help="with --full-trace: stitch-replay through a "
+                        "job-table of this size instead of the training "
+                        "window_jobs — the policy nets are max_jobs-"
+                        "independent, so a deeper stitch window widens "
+                        "the backlog held between seams")
     return p
 
 
@@ -115,6 +121,9 @@ def main(argv: list[str] | None = None) -> dict:
         sys.exit("--eval-windows applies to the plain per-window JCT "
                  "table (population views carry no source trace; the "
                  "other modes define their own window batch)")
+    if args.stitch_window_jobs is not None and not args.full_trace:
+        sys.exit("--stitch-window-jobs applies to --full-trace stitched "
+                 "replay only")
 
     if args.baselines_only:
         _, windows, _, _, _, _, _ = build_stack(cfg)
@@ -169,10 +178,21 @@ def main(argv: list[str] | None = None) -> dict:
         print(json.dumps(_json_safe(report)))
         return report
     if args.full_trace:
+        stitch_params = None
+        if args.stitch_window_jobs is not None:
+            if cfg.n_pods > 1:
+                sys.exit("--stitch-window-jobs applies to flat configs "
+                         "(full-trace evaluation has no hierarchical "
+                         "form)")
+            stitch_params = dataclasses.replace(
+                exp.env_params, sim=dataclasses.replace(
+                    exp.env_params.sim,
+                    max_jobs=args.stitch_window_jobs))
         report = full_trace_report(exp, max_jobs=args.max_jobs,
                                    include_random=not args.no_random,
                                    percentiles=PERCENTILES
-                                   if args.percentiles else None)
+                                   if args.percentiles else None,
+                                   env_params=stitch_params)
     else:
         eval_windows = None
         if args.eval_windows is not None and \

@@ -241,6 +241,32 @@ class TestFullTraceReplay:
         assert pct["fifo"]["p50"] == pytest.approx(
             np.percentile(ref, 50), rel=1e-6)
 
+    def test_full_trace_stitch_window_override(self):
+        """A checkpoint can stitch-replay through a DEEPER window than it
+        trained with (policy nets are max_jobs-independent); the deeper
+        window must need fewer stitched windows, complete every job, and
+        reject cluster-shape changes."""
+        cfg = dataclasses.replace(small_cfg(), window_jobs=16)
+        exp = Experiment.build(cfg)
+        base = eval_lib.full_trace_report(exp, max_jobs=60,
+                                          include_random=False,
+                                          baselines=("fifo",))
+        deep_params = dataclasses.replace(
+            exp.env_params, sim=dataclasses.replace(exp.env_params.sim,
+                                                    max_jobs=48))
+        deep = eval_lib.full_trace_report(exp, max_jobs=60,
+                                          include_random=False,
+                                          baselines=("fifo",),
+                                          env_params=deep_params)
+        assert deep["n_jobs"] == base["n_jobs"] == 60
+        assert deep["policy_windows"] < base["policy_windows"]
+        assert np.isfinite(deep["policy"]) and deep["policy"] > 0
+        bad = dataclasses.replace(
+            exp.env_params, sim=dataclasses.replace(exp.env_params.sim,
+                                                    queue_len=8))
+        with pytest.raises(ValueError, match="stitch window"):
+            eval_lib.full_trace_report(exp, env_params=bad)
+
     @staticmethod
     def _fifo_apply(_params, obs, mask):
         """Hand policy: lowest valid queue slot (FIFO-with-backfill),
