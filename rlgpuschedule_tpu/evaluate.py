@@ -40,6 +40,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--window-jobs", type=int, default=None)
     p.add_argument("--queue-len", type=int, default=None)
     p.add_argument("--horizon", type=int, default=None)
+    p.add_argument("--obs-kind", default=None,
+                   choices=["flat", "grid", "graph"],
+                   help="must match the training run when restoring a "
+                        "checkpoint (same contract as the cluster-shape "
+                        "overrides)")
     p.add_argument("--drain-frac", type=float, default=None,
                    help="evaluate on backlog-drain copies of this fraction "
                         "of the windows (all jobs at t=0) — the regime the "
@@ -102,7 +107,7 @@ def main(argv: list[str] | None = None) -> dict:
              "n_envs": args.n_envs, "n_nodes": args.n_nodes,
              "gpus_per_node": args.gpus_per_node,
              "window_jobs": args.window_jobs, "queue_len": args.queue_len,
-             "horizon": args.horizon,
+             "horizon": args.horizon, "obs_kind": args.obs_kind,
              "drain_frac": args.drain_frac}.items() if v is not None}
     cfg = dataclasses.replace(cfg, **over)
 

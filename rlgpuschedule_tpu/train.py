@@ -43,6 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pending-queue slots the agent sees/acts on (the "
                         "policy's visibility into the backlog)")
     p.add_argument("--horizon", type=int, default=None)
+    p.add_argument("--obs-kind", default=None,
+                   choices=["flat", "grid", "graph"],
+                   help="override the preset's observation/encoder family "
+                        "(e.g. train config 2's cluster on the flat MLP "
+                        "encoder on a CPU host)")
     p.add_argument("--trace", default=None,
                    choices=["synthetic", "philly", "pai", "philly-proxy",
                             "pai-proxy"],
@@ -121,7 +126,7 @@ def apply_overrides(cfg: ExperimentConfig,
               "n_envs": args.n_envs, "n_nodes": args.n_nodes,
               "gpus_per_node": args.gpus_per_node,
               "window_jobs": args.window_jobs, "horizon": args.horizon,
-              "queue_len": args.queue_len,
+              "queue_len": args.queue_len, "obs_kind": args.obs_kind,
               "trace": args.trace, "trace_path": args.trace_path,
               "trace_load": args.trace_load,
               "resample_every": args.resample_every,

@@ -127,6 +127,20 @@ class TestTrainCLI:
         with pytest.raises(SystemExit):
             train_cli.apply_overrides(CONFIGS["a2c-pai-fair"], bad)
 
+    def test_obs_kind_override(self):
+        # --obs-kind swaps the preset's encoder family (e.g. config 2's
+        # grid CNN down to the flat MLP for a CPU-host training run)
+        args = train_cli.build_parser().parse_args(
+            ["--config", "ppo-cnn-philly512", "--obs-kind", "flat"])
+        from rlgpuschedule_tpu.configs import CONFIGS
+        cfg = train_cli.apply_overrides(CONFIGS["ppo-cnn-philly512"], args)
+        assert cfg.obs_kind == "flat"
+        # no override keeps the preset encoder
+        args = train_cli.build_parser().parse_args(
+            ["--config", "ppo-cnn-philly512"])
+        cfg = train_cli.apply_overrides(CONFIGS["ppo-cnn-philly512"], args)
+        assert cfg.obs_kind == "grid"
+
     def test_eval_every_probe(self, tmp_path):
         # --eval-every: held-out greedy replay scored vs cached baselines,
         # logged to a separate .eval.csv stream (schemas differ from the
