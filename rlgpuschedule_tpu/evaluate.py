@@ -30,7 +30,17 @@ def build_parser() -> argparse.ArgumentParser:
         prog="rlgpuschedule_tpu.evaluate",
         description="JCT evaluation: trained policy vs baseline schedulers.")
     p.add_argument("--config", default="ppo-mlp-synth64")
+    p.add_argument("--trace", default=None,
+                   choices=["synthetic", "philly", "pai", "philly-proxy",
+                            "pai-proxy"],
+                   help="trace source override (same contract as train)")
     p.add_argument("--trace-path", default=None)
+    p.add_argument("--trace-load", type=float, default=None,
+                   help="proxy traces: offered-load target of the "
+                        "EVALUATION stream (a replay-time knob, not part "
+                        "of the checkpointed policy — e.g. evaluate a "
+                        "load-1.1-trained policy on a load-1.6 overload "
+                        "stream)")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--n-envs", type=int, default=None)
     # cluster-shape overrides — MUST match the training run when restoring
@@ -103,7 +113,8 @@ def main(argv: list[str] | None = None) -> dict:
         sys.exit(f"unknown config {args.config!r}")
     cfg = CONFIGS[args.config]
     over = {k: v for k, v in
-            {"trace_path": args.trace_path, "seed": args.seed,
+            {"trace": args.trace, "trace_path": args.trace_path,
+             "trace_load": args.trace_load, "seed": args.seed,
              "n_envs": args.n_envs, "n_nodes": args.n_nodes,
              "gpus_per_node": args.gpus_per_node,
              "window_jobs": args.window_jobs, "queue_len": args.queue_len,
