@@ -129,18 +129,28 @@ def run_ppo_epochs(apply_fn: PolicyApply, config: PPOConfig, state,
         state, key = state_and_key
         key, sub = jax.random.split(key)
         perm = jax.random.permutation(sub, B)
-        mb_idx = perm.reshape(config.n_minibatches, mb_size)
+        # ONE whole-batch gather per epoch, then scan over contiguous
+        # [n_mb, mb, ...] blocks — identical minibatch contents to
+        # gathering x[perm[i]] inside the scan body (same perm, same row
+        # order), but the inner loop reads each minibatch as a contiguous
+        # dynamic-slice instead of issuing a fresh row-gather per
+        # minibatch (the update scan is the measured hot stage —
+        # BASELINE.md "where the time goes").
+        shuffled = jax.tree.map(
+            lambda x: x[perm].reshape(config.n_minibatches, mb_size,
+                                      *x.shape[1:]),
+            (flat, adv_flat, ret_flat))
 
-        def minibatch(state, idx):
-            mb = jax.tree.map(lambda x: x[idx], flat)
+        def minibatch(state, mb_data):
+            mb, adv, ret = mb_data
             (loss, aux), grads = jax.value_and_grad(
                 ppo_loss, argnums=1, has_aux=True)(
-                apply_fn, _params_of(state), mb, adv_flat[idx],
-                ret_flat[idx], config, clip_eps=clip_eps, ent_coef=ent_coef)
+                apply_fn, _params_of(state), mb, adv, ret,
+                config, clip_eps=clip_eps, ent_coef=ent_coef)
             state = apply_grads(state, grads)
             return state, (loss, *aux)
 
-        state, stats = jax.lax.scan(minibatch, state, mb_idx)
+        state, stats = jax.lax.scan(minibatch, state, shuffled)
         return (state, key), stats
 
     (state, _), stats = jax.lax.scan(epoch, (state, key), None,
