@@ -103,6 +103,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "window_jobs — the policy nets are max_jobs-"
                         "independent, so a deeper stitch window widens "
                         "the backlog held between seams")
+    p.add_argument("--backlog-gate", type=int, default=0,
+                   help="evaluate the backlog-gated HYBRID scheduler: "
+                        "when fewer than N jobs are pending, play FIFO "
+                        "(place the oldest job if it fits) instead of "
+                        "the policy. A drain-trained policy adds "
+                        "ordering delay on underloaded streams where "
+                        "placing immediately is optimal (measured, "
+                        "BASELINE.md config 4); the gate recovers the "
+                        "FIFO tie there and keeps the learned policy "
+                        "where backlogs are deep. Flat configs, policy "
+                        "row only")
     return p
 
 
@@ -140,6 +151,15 @@ def main(argv: list[str] | None = None) -> dict:
     if args.stitch_window_jobs is not None and not args.full_trace:
         sys.exit("--stitch-window-jobs applies to --full-trace stitched "
                  "replay only")
+    if args.backlog_gate < 0:
+        sys.exit("--backlog-gate must be >= 0 (a negative gate would "
+                 "silently run ungated)")
+    if args.backlog_gate and (args.pbt or args.fairness or
+                              args.baselines_only or cfg.n_pods > 1):
+        sys.exit("--backlog-gate applies to the flat per-window and "
+                 "--full-trace policy tables (the hierarchical action "
+                 "space has no single FIFO fall-through action; "
+                 "--baselines-only has no policy row)")
 
     if args.baselines_only:
         _, windows, _, _, _, _, _ = build_stack(cfg)
@@ -208,7 +228,8 @@ def main(argv: list[str] | None = None) -> dict:
                                    include_random=not args.no_random,
                                    percentiles=PERCENTILES
                                    if args.percentiles else None,
-                                   env_params=stitch_params)
+                                   env_params=stitch_params,
+                                   backlog_gate=args.backlog_gate)
     else:
         eval_windows = None
         if args.eval_windows is not None and \
@@ -227,7 +248,8 @@ def main(argv: list[str] | None = None) -> dict:
                             max_steps=args.max_steps,
                             include_random=not args.no_random,
                             percentiles=PERCENTILES if args.percentiles
-                            else None)
+                            else None,
+                            backlog_gate=args.backlog_gate)
     print(format_report(report), file=sys.stderr)
     out = {k: v for k, v in report.items() if isinstance(v, (int, float))}
     if "percentiles" in report:
