@@ -46,6 +46,14 @@ class ExperimentConfig:
     time_scale: float = 600.0
     reward_scale: float = 10_000.0
     place_bonus: float = 0.05   # shaping vs the idle local optimum (rewards.py)
+    # preemptive configs: reward charge per preemption AND per
+    # re-placement. Without it the agent can stall the clock forever in
+    # a zero-dt place<->preempt cycle (the pause-the-game exploit,
+    # measured: a 3000-iteration preempt run completed ZERO jobs at
+    # replay); an under-priced charge (0.05) measurably left stalling
+    # return-optimal under discounting — see rewards.preempt_charge for
+    # the magnitude analysis behind 0.25.
+    preempt_cost: float = 0.25
     # training
     ppo: PPOConfig = PPOConfig()
     a2c: A2CConfig = A2CConfig()

@@ -37,6 +37,7 @@ class EnvParams:
     time_scale: float = 600.0     # normalizes times in observations
     reward_scale: float = 1000.0  # divides reward magnitudes
     place_bonus: float = 0.0      # potential-based shaping (rewards.py)
+    preempt_cost: float = 0.0     # anti-stall preemption charge (rewards.py)
     horizon: int = 512            # max decision steps per episode
 
     @property
@@ -112,6 +113,13 @@ def step(params: EnvParams, state: EnvState, trace: Trace,
     else:
         reward = reward_lib.reward_jct(info, params.reward_scale,
                                        params.place_bonus)
+    # the anti-stall preemption charge is a property of the ACTION SPACE
+    # (any preemptive config can generate zero-dt actions forever — the
+    # pause-the-game exploit, rewards.preempt_charge), not of one reward
+    # function, so it applies after whichever reward branch ran
+    if params.preempt_cost:
+        reward = reward + reward_lib.preempt_charge(info,
+                                                    params.preempt_cost)
     t = state.t + 1
     done = info.done | (t >= params.horizon)
     new_state = EnvState(sim=sim, t=t)

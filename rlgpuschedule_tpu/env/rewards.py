@@ -11,6 +11,38 @@ import jax.numpy as jnp
 from ..sim.core import SimState, Trace, StepInfo, PENDING, RUNNING
 
 
+def preempt_charge(info: StepInfo, preempt_cost: float) -> jax.Array:
+    """Anti-stall charge for preemptive action spaces: −``preempt_cost``
+    per PREEMPTION and per RE-placement (a placement of a job that
+    already ran — only possible after a preemption).
+
+    Why it exists (measured): the JCT/fairness rewards only charge
+    −f(n)·dt, placements and preemptions cost no simulated time, and
+    with preemption available the agent can ALWAYS generate a zero-dt
+    action — so an infinite place↔preempt cycle never pays the backlog
+    penalty and stalling the clock forever is return-optimal inside the
+    horizon (a 3000-iteration ppo-mlp-preempt run completed ZERO of 192
+    jobs at replay, greedy AND sampled — the pause-the-game exploit).
+    Both legs of the cycle are charged; first placements are never
+    charged (see ``reward_jct``'s place_bonus, which still REWARDS
+    them).
+
+    Tuning: a genuinely useful demotion pays the charge TWICE over its
+    lifetime — once at the preemption and once at the unavoidable later
+    re-placement — so the break-even JCT gain per demotion is
+    ≈ 2·preempt_cost (in reward units). The magnitude must also
+    dominate the discounted per-step cost of real scheduling: with
+    γ=0.995 a stalling policy's γ-sum over a 1024-step horizon is
+    ≈200·cost, while the discounted JCT penalty of actually draining a
+    deep backlog is of order −20 at the default scales — a 0.05 cost
+    measurably left stalling OPTIMAL (the cycle survived retraining),
+    which is why the preset charges 0.25. This charge is applied by
+    ``env.step`` AFTER whichever reward branch ran: the exploit is a
+    property of the action space, not of one reward function."""
+    replaced = info.placed & ~info.first_placed
+    return -preempt_cost * (info.preempted | replaced).astype(jnp.float32)
+
+
 def reward_jct(info: StepInfo, reward_scale: float,
                place_bonus: float = 0.0) -> jax.Array:
     """Exact JCT objective: Σ JCT = ∫ n_in_system(t) dt, so accumulating
@@ -30,7 +62,11 @@ def reward_jct(info: StepInfo, reward_scale: float,
     without it). NOTE: with episodes cut at the env horizon the telescoping
     argument is approximate at the boundary — eval replay (eval.py) scores
     policies with the unshaped JCT objective, so reported JCT numbers are
-    unaffected."""
+    unaffected.
+
+    Preemptive action spaces additionally need the anti-stall
+    :func:`preempt_charge`, applied by ``env.step`` after this (or the
+    fairness) reward — see its docstring for the exploit and tuning."""
     base = -(info.dt * info.in_system_before.astype(jnp.float32)) / reward_scale
     if place_bonus:
         return base + place_bonus * info.first_placed.astype(jnp.float32)

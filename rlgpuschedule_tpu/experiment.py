@@ -32,7 +32,8 @@ def build_env_params(cfg: ExperimentConfig) -> EnvParams:
     return EnvParams(sim=sim, obs_kind=cfg.obs_kind,
                      reward_kind=cfg.reward_kind, n_tenants=cfg.n_tenants,
                      time_scale=cfg.time_scale, reward_scale=cfg.reward_scale,
-                     place_bonus=cfg.place_bonus, horizon=cfg.horizon)
+                     place_bonus=cfg.place_bonus,
+                     preempt_cost=cfg.preempt_cost, horizon=cfg.horizon)
 
 
 def load_source_trace(cfg: ExperimentConfig, n_jobs: int | None = None,

@@ -89,6 +89,41 @@ class TestResetStep:
                 break
         assert bool(ts.done)
 
+    @pytest.mark.parametrize("reward_kind", ["jct", "fair"])
+    def test_preempt_cost_charges_the_stall_cycle(self, reward_kind):
+        # the pause-the-game exploit: place<->preempt advances no sim
+        # time; with preempt_cost each round trip must read strictly
+        # negative reward (and the placement leg pays no place_bonus —
+        # only FIRST placements do). Parametrized over BOTH reward
+        # branches: the charge lives at env.step level because the
+        # exploit is an action-space property, not a reward-function one
+        import dataclasses as dc
+        params = make_params(reward_kind=reward_kind)
+        place_bonus = 0.05 if reward_kind == "jct" else 0.0
+        params = dc.replace(
+            params, preempt_cost=0.05, place_bonus=place_bonus,
+            sim=dc.replace(params.sim, preempt_len=2))
+        trace = make_trace()
+        state, ts = reset(params, trace)
+        K, P = params.sim.queue_len, params.sim.n_placements
+        place_head = jnp.int32(0)
+        preempt_0 = jnp.int32(K * P)       # first preempt slot
+        state, ts = step(params, state, trace, place_head)
+        first = float(ts.reward)           # first placement: bonus, dt=0
+        assert first == pytest.approx(place_bonus)
+        total = 0.0
+        for _ in range(3):                 # preempt -> re-place cycles
+            state, ts = step(params, state, trace, preempt_0)
+            assert bool(ts.info.preempted)
+            assert float(ts.reward) == pytest.approx(-0.05)
+            total += float(ts.reward)
+            state, ts = step(params, state, trace, place_head)
+            # the re-place leg is charged too (both legs of the stall
+            # cycle must bleed) and earns no place_bonus
+            assert float(ts.reward) == pytest.approx(-0.05)
+            total += float(ts.reward)
+        assert total == pytest.approx(6 * -0.05)
+
     def test_fair_reward_penalizes_concentration(self):
         jobs_conc = [JobRecord(i, 0.0, 100.0, 1, tenant=0) for i in range(4)]
         jobs_even = [JobRecord(i, 0.0, 100.0, 1, tenant=i % 4) for i in range(4)]
