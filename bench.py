@@ -39,20 +39,28 @@ def tpu_healthy(timeout_s: float = 75.0, attempts: int = 3) -> bool:
     subprocess so we can time out and fall back. One probe can also time
     out spuriously when the host is briefly loaded (measured: a parallel
     pytest run pushed JAX init past 75s on the 1-core rig and the bench
-    silently recorded a CPU number), so retry a couple of times before
-    concluding the tunnel is down."""
-    for _ in range(attempts):
+    silently recorded a CPU number), so retry before concluding the
+    tunnel is down — but with an ESCALATING timeout (short first probe),
+    so a genuinely dead tunnel costs ~30s + retries, not attempts × the
+    full window (ADVICE r3: 3 × 75s stalled a dead-tunnel bench ~225s)."""
+    timeouts = [min(30.0, timeout_s)] + [timeout_s] * max(attempts - 1, 0)
+    for t in timeouts:
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; d=jax.devices(); print(d[0].platform)"],
-                capture_output=True, text=True, timeout=timeout_s)
+                capture_output=True, text=True, timeout=t)
             # require the probe to actually SEE the TPU: a jax that falls
             # back to CPU exits 0 too, and treating that as healthy would
             # re-import jax under the tunnel sitecustomize with no timeout
             # guard (the exact hang the probe exists to avoid)
             if r.returncode == 0 and r.stdout.strip() == "tpu":
                 return True
+            if r.returncode == 0:
+                # fast clean exit WITHOUT the chip: jax initialized some
+                # other platform — the tunnel is conclusively down, and
+                # retrying cannot change that (only hangs are ambiguous)
+                return False
         except subprocess.TimeoutExpired:
             pass
     return False

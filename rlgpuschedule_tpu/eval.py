@@ -45,6 +45,30 @@ def _greedy_actions(logits: Any) -> Any:
     return jax.tree.map(lambda lg: jnp.argmax(lg, axis=-1), logits)
 
 
+def _preempt_slice(env_params: EnvParams) -> jax.Array | None:
+    """bool[n_actions] marking the preempt actions, or None if the flat
+    action space has none (guard is then a no-op)."""
+    if isinstance(env_params, HierParams) or not env_params.sim.preempt_len:
+        return None
+    sim = env_params.sim
+    kp = sim.queue_len * sim.n_placements
+    pre = np.zeros(sim.n_actions, bool)
+    pre[kp:kp + sim.preempt_len] = True
+    return jnp.asarray(pre)
+
+
+def _stall_threshold(env_params: EnvParams) -> int:
+    """Upper bound on LEGITIMATE consecutive zero-dt decision steps.
+
+    At one sim instant a policy can place at most ``queue_len`` distinct
+    pending jobs (a placed job leaves the queue) and rearrange at most
+    ``preempt_len`` running ones; anything beyond that bound within a
+    single clock instant is revisiting — i.e. a place↔preempt cycle. The
+    +4 keeps the bound safely above any interleaving slack."""
+    sim = env_params.sim
+    return sim.queue_len + sim.preempt_len + 4
+
+
 def _random_actions(key: jax.Array, mask: Any) -> Any:
     logits = jax.tree.map(lambda m: jnp.where(m, 0.0, -1e9), mask)
     actions, _ = action_dist.sample(key, logits)
@@ -118,6 +142,7 @@ def replay(apply_fn: Callable, net_params: Any,
            traces: core.Trace, max_steps: int | None = None,
            policy: str = "greedy", key: jax.Array | None = None,
            return_states: bool = False, backlog_gate: int = 0,
+           stall_guard: bool = True,
            ) -> "EvalResult | tuple[EvalResult, Any]":
     """Deterministically replay the batched trace windows under the policy
     (flat configs 1-4 and the hierarchical config 5 share this harness).
@@ -133,6 +158,19 @@ def replay(apply_fn: Callable, net_params: Any,
 
     ``backlog_gate``: >0 evaluates the backlog-gated HYBRID scheduler —
     see :func:`_gate_to_fifo` (flat configs only).
+
+    ``stall_guard`` (preemptive configs, greedy replay only): break the
+    measured place↔preempt argmax deadlock (BASELINE.md config-1p: 1 of 8
+    held-out drain windows froze at 87.7% completion, invariant to
+    horizon — a zero-sim-time cycle the anti-stall TRAINING charge cannot
+    reach because argmax replay has no exploration). Mechanism: count
+    consecutive zero-dt decision steps per env; past
+    :func:`_stall_threshold` (the bound on legitimate same-instant
+    activity) mask every preempt action until the clock next advances.
+    With preempts held, a zero-dt run is finite — each placement removes
+    a pending job and no-op advances to the next event — so every cycle
+    terminates; sub-threshold behavior is bit-identical to the unguarded
+    replay.
     """
     if policy not in ("greedy", "random"):
         raise ValueError(f"unknown replay policy {policy!r}; "
@@ -140,6 +178,11 @@ def replay(apply_fn: Callable, net_params: Any,
     if backlog_gate < 0:
         raise ValueError("backlog_gate must be >= 0 (a negative gate "
                          "never engages — silently ungated)")
+    if backlog_gate and policy == "random":
+        raise ValueError("backlog_gate composes with the LEARNED policy "
+                         "only: gating the random control would overwrite "
+                         "its actions with FIFO whenever the backlog is "
+                         "shallow, silently inflating the baseline")
     if backlog_gate and isinstance(env_params, HierParams):
         raise ValueError("backlog_gate applies to flat configs (the "
                          "hierarchical action space has no single FIFO "
@@ -151,9 +194,14 @@ def replay(apply_fn: Callable, net_params: Any,
 
     ops = _env_ops(env_params)
     step_one = jax.vmap(ops.step)
+    pre = (_preempt_slice(env_params)
+           if stall_guard and policy == "greedy" else None)
+    thresh = _stall_threshold(env_params) if pre is not None else 0
 
     def scan_step(carry, k):
-        state, obs, mask, done, busy_time = carry
+        state, obs, mask, done, busy_time, stall = carry
+        if pre is not None:
+            mask = mask & ~((stall >= thresh)[:, None] & pre[None, :])
         if policy == "random":
             actions = _random_actions(k, mask)
         else:
@@ -165,6 +213,7 @@ def replay(apply_fn: Callable, net_params: Any,
         new_state, new_ts = step_one(state, traces, actions)
         dt = jnp.where(done, 0.0, new_ts.info.dt)
         busy_time = busy_time + ops.busy(state) * dt
+        stall = jnp.where(done | (new_ts.info.dt > 0.0), 0, stall + 1)
         # freeze finished envs: keep the old state/obs/mask once done
         keep = lambda old, new: jnp.where(
             done.reshape((-1,) + (1,) * (new.ndim - 1)), old, new)
@@ -173,12 +222,15 @@ def replay(apply_fn: Callable, net_params: Any,
         obs = tkeep(obs, new_ts.obs)
         mask = tkeep(mask, new_ts.action_mask)
         done = done | new_ts.done
-        return (state, obs, mask, done, busy_time), None
+        return (state, obs, mask, done, busy_time, stall), None
 
     keys = jax.random.split(key, max_steps)
     init = (state, ts.obs, ts.action_mask,
-            jnp.zeros(ts.done.shape, bool), jnp.zeros(ts.done.shape, jnp.float32))
-    (state, _, _, done, busy_time), _ = jax.lax.scan(scan_step, init, keys)
+            jnp.zeros(ts.done.shape, bool),
+            jnp.zeros(ts.done.shape, jnp.float32),
+            jnp.zeros(ts.done.shape, jnp.int32))
+    (state, _, _, done, busy_time, _), _ = jax.lax.scan(scan_step, init,
+                                                        keys)
 
     stats = jax.vmap(ops.jct_stats)(state, traces)
     makespan = ops.makespan(state)
@@ -199,7 +251,8 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
                       max_steps_per_window: int | None = None,
                       policy: str = "greedy",
                       key: jax.Array | None = None,
-                      backlog_gate: int = 0) -> dict[str, Any]:
+                      backlog_gate: int = 0,
+                      stall_guard: bool = True) -> dict[str, Any]:
     """Policy avg-JCT over an ENTIRE source trace via sequential windowed
     replay with residual carry (VERDICT r1 missing #4) — one number
     comparable to the ``native``/oracle baselines over the same trace
@@ -240,6 +293,11 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
     if backlog_gate < 0:
         raise ValueError("backlog_gate must be >= 0 (a negative gate "
                          "never engages — silently ungated)")
+    if backlog_gate and policy == "random":
+        raise ValueError("backlog_gate composes with the LEARNED policy "
+                         "only: gating the random control would overwrite "
+                         "its actions with FIFO whenever the backlog is "
+                         "shallow, silently inflating the baseline")
     if key is None:
         key = jax.random.PRNGKey(0)
     sim = env_params.sim
@@ -247,6 +305,9 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
     S = int(max_steps_per_window or 4 * J + 16)
     # replay wants no horizon cut: only completion / cutoff freeze
     rp = dataclasses.replace(env_params, horizon=S + 1)
+    pre = (_preempt_slice(env_params)
+           if stall_guard and policy == "greedy" else None)
+    thresh = _stall_threshold(env_params) if pre is not None else 0
 
     @jax.jit
     def _window(net_params, trace: core.Trace, cutoff, need_completion,
@@ -259,7 +320,10 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
         state, ts = env_lib.reset(rp, trace)
 
         def scan_step(carry, k):
-            state, obs, mask, frozen = carry
+            state, obs, mask, frozen, stall = carry
+            if pre is not None:
+                # same zero-dt cycle breaker as replay(): see its docstring
+                mask = mask & ~((stall >= thresh) & pre)
             if policy == "random":
                 # masked-uniform; _random_actions expects a batch axis
                 action = jax.tree.map(
@@ -284,11 +348,13 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
             obs = keep(obs, new_ts.obs)
             mask = keep(mask, new_ts.action_mask)
             frozen = stop | new_ts.done
-            return (state, obs, mask, frozen), None
+            stall = jnp.where(frozen | (new_ts.info.dt > 0.0), 0, stall + 1)
+            return (state, obs, mask, frozen, stall), None
 
-        init = (state, ts.obs, ts.action_mask, jnp.bool_(False))
-        (state, _, _, _), _ = jax.lax.scan(scan_step, init,
-                                           jax.random.split(wkey, S))
+        init = (state, ts.obs, ts.action_mask, jnp.bool_(False),
+                jnp.int32(0))
+        (state, _, _, _, _), _ = jax.lax.scan(scan_step, init,
+                                              jax.random.split(wkey, S))
         # future-cutoff freeze keeps the last decision point NOT beyond the
         # cutoff; between that clock and the cutoff there are no events (the
         # next one overshot), only continuous service — advance it, or
@@ -465,6 +531,10 @@ def jct_report(exp, windows: list[ArrayTrace] | None = None,
 
     report: dict[str, Any] = {}
     pcts: dict[str, dict[str, float]] = {}
+    if backlog_gate:
+        # saved artifacts from gated and ungated runs must be
+        # distinguishable (ADVICE r3): record the gate next to the row
+        report["backlog_gate"] = int(backlog_gate)
     # the gate is part of the scheduler under evaluation (policy+FIFO
     # hybrid); the random control row stays pure random
     res, states = replay(exp.apply_fn, exp.train_state.params,
@@ -555,6 +625,8 @@ def full_trace_report(exp, max_jobs: int | None = None,
     report: dict[str, Any] = {"policy": out["avg_jct"],
                               "n_jobs": out["n_jobs"],
                               "policy_windows": out["windows"]}
+    if backlog_gate:
+        report["backlog_gate"] = int(backlog_gate)
     if percentiles is not None:
         # full_trace_replay asserts every job finished, so unlike the
         # per-window harness there is no truncation bias to guard

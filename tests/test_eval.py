@@ -12,6 +12,7 @@ from rlgpuschedule_tpu.configs import CONFIGS
 from rlgpuschedule_tpu.env import stack_traces
 from rlgpuschedule_tpu.env.env import EnvParams
 from rlgpuschedule_tpu.traces import gen_poisson_trace
+from rlgpuschedule_tpu.traces.records import ArrayTrace
 from rlgpuschedule_tpu.experiment import (Experiment, load_source_trace,
                                           make_env_windows)
 from rlgpuschedule_tpu.sim.core import SimParams, validate_trace
@@ -205,6 +206,155 @@ class TestBacklogGate:
             n_nodes=2, gpus_per_node=4, max_jobs=8, queue_len=4))
         with pytest.raises(ValueError, match="backlog_gate"):
             eval_lib.replay(None, {}, hp, None, backlog_gate=4)
+
+    def test_gate_rejected_for_random_policy(self, exp, windows):
+        # ADVICE r3: gating the random control would silently turn it
+        # into a FIFO hybrid, inflating the baseline — must refuse
+        traces = stack_traces(windows, exp.env_params)
+        with pytest.raises(ValueError, match="random"):
+            eval_lib.replay(exp.apply_fn, exp.train_state.params,
+                            exp.env_params, traces, policy="random",
+                            backlog_gate=2)
+        with pytest.raises(ValueError, match="random"):
+            eval_lib.full_trace_replay(exp.apply_fn,
+                                       exp.train_state.params,
+                                       exp.env_params, windows[0],
+                                       policy="random", backlog_gate=2)
+
+    def test_gate_mid_threshold_switches_within_episode(self):
+        """ADVICE r3: the CLI ships MID-range gates, but only the two
+        extremes were pinned. Hand-built arrival pattern: two solo
+        arrivals (backlog < gate → FIFO engages, places them) then a
+        simultaneous pair (backlog >= gate → the learned policy — here an
+        adversarial no-op-preferring one — keeps control and strands
+        them). The mid-gate replay must therefore land strictly between
+        pure-policy (0 done) and always-on FIFO (all done)."""
+        sim = SimParams(n_nodes=2, gpus_per_node=4, max_jobs=8,
+                        queue_len=4)
+        params = EnvParams(sim=sim, obs_kind="flat", horizon=256)
+        J = sim.max_jobs
+        submit = np.full(J, np.inf, np.float32)
+        submit[:4] = [0.0, 100.0, 200.0, 200.0]
+        duration = np.full(J, 1.0, np.float32)
+        duration[:4] = 50.0
+        gpus = np.zeros(J, np.int32)
+        gpus[:4] = 1
+        tr = ArrayTrace(submit, duration, gpus, np.zeros(J, np.int32),
+                        (np.arange(J) < 4))
+        traces = stack_traces([tr], params)
+
+        def junk_apply(_params, obs, mask):
+            import jax.numpy as jnp
+            prefs = jnp.arange(mask.shape[-1], dtype=jnp.float32)
+            return jnp.where(mask, prefs, -1e9), jnp.zeros(obs.shape[:-1])
+
+        pure = eval_lib.replay(junk_apply, {}, params, traces)
+        fifo = eval_lib.replay(junk_apply, {}, params, traces,
+                               backlog_gate=sim.max_jobs + 1)
+        mid = eval_lib.replay(junk_apply, {}, params, traces,
+                              backlog_gate=2)
+        assert int(np.asarray(pure.n_done)[0]) == 0
+        assert int(np.asarray(fifo.n_done)[0]) == 4
+        assert int(np.asarray(mid.n_done)[0]) == 2
+
+
+class TestStallGuard:
+    """Eval-time breaker for the measured place↔preempt argmax deadlock
+    (BASELINE.md config-1p: 1 of 8 drain windows froze at 87.7%
+    completion). The guard masks preempt actions after the legitimate
+    zero-dt activity bound; sub-threshold replay is untouched."""
+
+    @staticmethod
+    def _params():
+        sim = SimParams(n_nodes=2, gpus_per_node=4, max_jobs=8,
+                        queue_len=4, preempt_len=2)
+        return EnvParams(sim=sim, obs_kind="flat", horizon=512)
+
+    @staticmethod
+    def _cycle_apply_for(env_params):
+        """Adversarial policy that realizes the deadlock exactly as the
+        trained policy did (BASELINE.md: `preempt3 → place126 → …` at
+        clock 0.0): prefer any preempt, else any placement, no-op last —
+        place→preempt→place forever at zero sim time."""
+        import jax.numpy as jnp
+        sim = env_params.sim
+        K, P, R = sim.queue_len, sim.n_placements, sim.preempt_len
+        prefs = jnp.concatenate([
+            jnp.ones(K * P), jnp.full((R,), 2.0),
+            jnp.zeros(1)]).astype(jnp.float32)
+
+        def apply(_params, obs, mask):
+            return jnp.where(mask, prefs, -1e9), jnp.zeros(obs.shape[:-1])
+
+        return apply
+
+    @staticmethod
+    def _drain_traces(params):
+        J = params.sim.max_jobs
+        submit = np.full(J, np.inf, np.float32)
+        submit[:6] = 0.0
+        duration = np.full(J, 1.0, np.float32)
+        duration[:6] = [60.0, 120.0, 90.0, 30.0, 45.0, 75.0]
+        gpus = np.zeros(J, np.int32)
+        gpus[:6] = [1, 2, 1, 1, 2, 1]
+        tr = ArrayTrace(submit, duration, gpus, np.zeros(J, np.int32),
+                        (np.arange(J) < 6))
+        return stack_traces([tr], params)
+
+    def test_guard_breaks_cycle_unguarded_deadlocks(self):
+        params = self._params()
+        apply = self._cycle_apply_for(params)
+        traces = self._drain_traces(params)
+        raw = eval_lib.replay(apply, {}, params, traces,
+                              stall_guard=False)
+        # the deadlock is real: zero completions across a 512-step replay
+        assert int(np.asarray(raw.n_done)[0]) == 0
+        guarded = eval_lib.replay(apply, {}, params, traces)
+        assert int(np.asarray(guarded.n_done)[0]) == 6
+        assert float(np.asarray(guarded.makespan)[0]) > 0.0
+
+    def test_guard_breaks_cycle_in_full_trace_stitch(self):
+        params = self._params()
+        apply = self._cycle_apply_for(params)
+        J = params.sim.max_jobs
+        submit = np.full(J, np.inf, np.float32)
+        submit[:6] = 0.0
+        duration = np.full(J, 1.0, np.float32)
+        duration[:6] = [60.0, 120.0, 90.0, 30.0, 45.0, 75.0]
+        gpus = np.zeros(J, np.int32)
+        gpus[:6] = [1, 2, 1, 1, 2, 1]
+        tr = ArrayTrace(submit, duration, gpus, np.zeros(J, np.int32),
+                        (np.arange(J) < 6))
+        # unguarded would trip the stitcher's no-progress RuntimeError;
+        # guarded completes every job (the function asserts finiteness)
+        out = eval_lib.full_trace_replay(apply, {}, params, tr)
+        assert out["n_jobs"] == 6
+        assert np.isfinite(out["jct"]).all()
+
+    def test_guard_leaves_subthreshold_replay_bit_identical(self):
+        """A legitimate preemptive policy below the zero-dt bound must
+        replay EXACTLY as without the guard (the guard only ever engages
+        past _stall_threshold consecutive zero-dt steps)."""
+        params = self._params()
+        import jax.numpy as jnp
+        sim = params.sim
+        K, P, R = sim.queue_len, sim.n_placements, sim.preempt_len
+        # place-everything policy: no preempt preference, no cycles
+        prefs = jnp.concatenate([
+            jnp.full((K * P,), 2.0), jnp.zeros(R),
+            jnp.ones(1)]).astype(jnp.float32)
+
+        def apply(_params, obs, mask):
+            return jnp.where(mask, prefs, -1e9), jnp.zeros(obs.shape[:-1])
+
+        traces = self._drain_traces(params)
+        a = eval_lib.replay(apply, {}, params, traces, stall_guard=False)
+        b = eval_lib.replay(apply, {}, params, traces, stall_guard=True)
+        np.testing.assert_array_equal(np.asarray(a.avg_jct),
+                                      np.asarray(b.avg_jct))
+        np.testing.assert_array_equal(np.asarray(a.steps),
+                                      np.asarray(b.steps))
+        assert int(np.asarray(a.n_done)[0]) == 6
 
 
 class TestFairnessReport:
