@@ -223,39 +223,57 @@ class TestBacklogGate:
 
     def test_gate_mid_threshold_switches_within_episode(self):
         """ADVICE r3: the CLI ships MID-range gates, but only the two
-        extremes were pinned. Hand-built arrival pattern: two solo
-        arrivals (backlog < gate → FIFO engages, places them) then a
-        simultaneous pair (backlog >= gate → the learned policy — here an
-        adversarial no-op-preferring one — keeps control and strands
-        them). The mid-gate replay must therefore land strictly between
-        pure-policy (0 done) and always-on FIFO (all done)."""
+        extremes were pinned. A stranding premise cannot distinguish them
+        (forced-progress liveness, ``sim/core.py`` ``rl_step``, places the
+        queue head whenever the event horizon empties — every policy
+        completes every feasible job). Instead drive the switch with a
+        policy whose ORDERING differs from FIFO: newest-first (LIFO).
+        Four full-cluster jobs run strictly serially, so per-job finish
+        times are a pure function of who controls each placement:
+
+        - pure LIFO places 0 (alone), then 3, 2, 1  → finish 50/200/150/100
+        - always-FIFO places 0, 1, 2, 3             → finish 50/100/150/200
+        - gate=3 (FIFO while backlog < 3): FIFO takes job 0 solo, LIFO
+          owns the 3-deep backlog at t=50 (places 3), FIFO resumes on the
+          2-deep remainder (1 then 2)               → finish 50/150/200/100
+
+        Three distinct vectors ⇒ the gate demonstrably switched control
+        mid-episode, both directions."""
         sim = SimParams(n_nodes=2, gpus_per_node=4, max_jobs=8,
                         queue_len=4)
         params = EnvParams(sim=sim, obs_kind="flat", horizon=256)
         J = sim.max_jobs
         submit = np.full(J, np.inf, np.float32)
-        submit[:4] = [0.0, 100.0, 200.0, 200.0]
+        submit[:4] = [0.0, 10.0, 20.0, 30.0]
         duration = np.full(J, 1.0, np.float32)
         duration[:4] = 50.0
         gpus = np.zeros(J, np.int32)
-        gpus[:4] = 1
+        gpus[:4] = sim.capacity  # whole cluster: strictly serial
         tr = ArrayTrace(submit, duration, gpus, np.zeros(J, np.int32),
                         (np.arange(J) < 4))
         traces = stack_traces([tr], params)
 
-        def junk_apply(_params, obs, mask):
+        def newest_first(_params, obs, mask):
             import jax.numpy as jnp
-            prefs = jnp.arange(mask.shape[-1], dtype=jnp.float32)
+            # highest feasible queue slot (queue is submit-sorted, so
+            # highest = newest); no-op only when nothing fits
+            prefs = jnp.arange(mask.shape[-1], dtype=jnp.float32) + 2.0
+            prefs = prefs.at[-1].set(0.5)
             return jnp.where(mask, prefs, -1e9), jnp.zeros(obs.shape[:-1])
 
-        pure = eval_lib.replay(junk_apply, {}, params, traces)
-        fifo = eval_lib.replay(junk_apply, {}, params, traces,
-                               backlog_gate=sim.max_jobs + 1)
-        mid = eval_lib.replay(junk_apply, {}, params, traces,
-                              backlog_gate=2)
-        assert int(np.asarray(pure.n_done)[0]) == 0
-        assert int(np.asarray(fifo.n_done)[0]) == 4
-        assert int(np.asarray(mid.n_done)[0]) == 2
+        def finishes(**kw):
+            res, state = eval_lib.replay(newest_first, {}, params, traces,
+                                         return_states=True, **kw)
+            assert int(np.asarray(res.n_done)[0]) == 4  # liveness holds
+            return np.asarray(state.sim.finish)[0, :4]
+
+        np.testing.assert_allclose(finishes(), [50, 200, 150, 100],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            finishes(backlog_gate=sim.max_jobs + 1), [50, 100, 150, 200],
+            rtol=1e-5)
+        np.testing.assert_allclose(finishes(backlog_gate=3),
+                                   [50, 150, 200, 100], rtol=1e-5)
 
 
 class TestStallGuard:
