@@ -252,7 +252,8 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
                       policy: str = "greedy",
                       key: jax.Array | None = None,
                       backlog_gate: int = 0,
-                      stall_guard: bool = True) -> dict[str, Any]:
+                      stall_guard: bool = True,
+                      drain_completions: int = 1) -> dict[str, Any]:
     """Policy avg-JCT over an ENTIRE source trace via sequential windowed
     replay with residual carry (VERDICT r1 missing #4) — one number
     comparable to the ``native``/oracle baselines over the same trace
@@ -286,6 +287,18 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
 
     The per-window program is jitted ONCE (fixed shapes) and reused for
     every window.
+
+    ``drain_completions``: in deep-backlog mode, freeze after this many
+    completions instead of 1, ingesting that many fresh jobs per window.
+    The default (1) reproduces the recorded round-3 tables bit-for-bit but
+    makes window count linear in the backlog EXCESS — a sustained-overload
+    100k-job stream would stitch ~10^5 windows. Batching completions cuts
+    the window count ~``drain_completions``× and REDUCES the seam-carry
+    tax (fewer seams); the cost is that already-arrived excluded jobs stay
+    invisible to the policy for up to that many completions longer (they
+    would sit at the tail of a backlog far deeper than the policy's queue
+    view anyway). Clamped to ``max_jobs // 2`` so every deep window still
+    ingests fresh work alongside its residuals.
     """
     if policy not in ("greedy", "random"):
         raise ValueError(f"unknown replay policy {policy!r}; "
@@ -300,8 +313,12 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
                          "shallow, silently inflating the baseline")
     if key is None:
         key = jax.random.PRNGKey(0)
+    if drain_completions < 1:
+        raise ValueError("drain_completions must be >= 1 (a deep-backlog "
+                         "window must free at least one table row)")
     sim = env_params.sim
     J = sim.max_jobs
+    drain_block = min(int(drain_completions), max(J // 2, 1))
     S = int(max_steps_per_window or 4 * J + 16)
     # replay wants no horizon cut: only completion / cutoff freeze
     rp = dataclasses.replace(env_params, horizon=S + 1)
@@ -339,8 +356,10 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
             done_before = jnp.sum(
                 (state.sim.status == DONE_STATUS) & trace.valid)
             # future cutoff: discard any step past it. already-arrived
-            # cutoff: run freely until a completion exists, then freeze
-            gate = jnp.where(need_completion, done_before >= 1, True)
+            # cutoff: run freely until drain_block completions exist,
+            # then freeze
+            gate = jnp.where(need_completion, done_before >= drain_block,
+                             True)
             stop = frozen | ((new_state.sim.clock > cutoff) & gate)
             keep = lambda old, new: jax.tree.map(
                 lambda o, n: jnp.where(stop, o, n), old, new)
@@ -444,7 +463,10 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
     assert np.isfinite(jct).all()
     return {"avg_jct": float(jct.mean()), "n_jobs": total,
             "jct": jct, "finish": finish_g, "tenant": tenant,
-            "windows": n_windows, "makespan": float(np.nanmax(finish_g))}
+            "windows": n_windows, "makespan": float(np.nanmax(finish_g)),
+            # EFFECTIVE batching after the max_jobs//2 clamp — the value
+            # that determines the replay, not the request
+            "drain_completions": drain_block}
 
 
 def pooled_avg_jct(result: EvalResult) -> tuple[float, float]:
@@ -501,6 +523,7 @@ def jct_report(exp, windows: list[ArrayTrace] | None = None,
                include_random: bool = True,
                percentiles: tuple[float, ...] | None = None,
                backlog_gate: int = 0,
+               stall_guard: bool = True,
                ) -> dict[str, Any]:
     """The full comparison table for an assembled Experiment: trained-policy
     greedy replay vs oracle baselines on identical windows.
@@ -535,11 +558,18 @@ def jct_report(exp, windows: list[ArrayTrace] | None = None,
         # saved artifacts from gated and ungated runs must be
         # distinguishable (ADVICE r3): record the gate next to the row
         report["backlog_gate"] = int(backlog_gate)
+    if _preempt_slice(exp.env_params) is not None:
+        # same distinguishability contract for the stall guard (VERDICT
+        # r4 weak #6): whenever the guard CAN engage (preemptive action
+        # space), record whether it did — guarded and unguarded numbers
+        # are different schedulers
+        report["stall_guard"] = bool(stall_guard)
     # the gate is part of the scheduler under evaluation (policy+FIFO
     # hybrid); the random control row stays pure random
     res, states = replay(exp.apply_fn, exp.train_state.params,
                          exp.env_params, traces, max_steps,
-                         return_states=True, backlog_gate=backlog_gate)
+                         return_states=True, backlog_gate=backlog_gate,
+                         stall_guard=stall_guard)
     report["policy"], report["policy_completion"] = pooled_avg_jct(res)
     report["policy_utilization"] = float(np.mean(np.asarray(res.utilization)))
     if percentiles is not None:
@@ -580,6 +610,8 @@ def full_trace_report(exp, max_jobs: int | None = None,
                       percentiles: tuple[float, ...] | None = None,
                       env_params: EnvParams | None = None,
                       backlog_gate: int = 0,
+                      stall_guard: bool = True,
+                      drain_completions: int = 1,
                       ) -> dict[str, Any]:
     """The FULL-trace comparison table (``evaluate --full-trace``): policy
     avg-JCT via :func:`full_trace_replay` vs the baselines run by the
@@ -621,12 +653,24 @@ def full_trace_report(exp, max_jobs: int | None = None,
     out = full_trace_replay(exp.apply_fn, exp.train_state.params,
                             eval_params, source,
                             max_steps_per_window=max_steps_per_window,
-                            backlog_gate=backlog_gate)
+                            backlog_gate=backlog_gate,
+                            stall_guard=stall_guard,
+                            drain_completions=drain_completions)
     report: dict[str, Any] = {"policy": out["avg_jct"],
                               "n_jobs": out["n_jobs"],
                               "policy_windows": out["windows"]}
     if backlog_gate:
         report["backlog_gate"] = int(backlog_gate)
+    if _preempt_slice(eval_params) is not None:
+        # see jct_report: mark guarded vs unguarded artifacts apart
+        report["stall_guard"] = bool(stall_guard)
+    if out["drain_completions"] != 1:
+        # non-default stitch batching is part of the evaluated scheduler's
+        # approximation — keep artifacts distinguishable (same contract as
+        # backlog_gate / stall_guard markers). Record the EFFECTIVE
+        # post-clamp value: a request clamped back to 1 IS the default
+        # replay and must not be marked as a different scheduler
+        report["drain_completions"] = int(out["drain_completions"])
     if percentiles is not None:
         # full_trace_replay asserts every job finished, so unlike the
         # per-window harness there is no truncation bias to guard
@@ -635,7 +679,8 @@ def full_trace_report(exp, max_jobs: int | None = None,
         rnd = full_trace_replay(exp.apply_fn, exp.train_state.params,
                                 eval_params, source,
                                 max_steps_per_window=max_steps_per_window,
-                                policy="random", key=jax.random.PRNGKey(1))
+                                policy="random", key=jax.random.PRNGKey(1),
+                                drain_completions=drain_completions)
         report["random"] = rnd["avg_jct"]
         if percentiles is not None:
             pcts["random"] = _pct_row(rnd["jct"], percentiles)

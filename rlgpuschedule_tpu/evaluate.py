@@ -103,6 +103,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "window_jobs — the policy nets are max_jobs-"
                         "independent, so a deeper stitch window widens "
                         "the backlog held between seams")
+    p.add_argument("--stitch-drain-jobs", type=int, default=1,
+                   help="with --full-trace: in deep-backlog mode, free "
+                        "this many job-table rows per stitched window "
+                        "instead of 1 before ingesting fresh jobs. The "
+                        "default reproduces the recorded tables exactly "
+                        "but makes window count linear in the backlog "
+                        "excess — set ~max_jobs/8 for sustained-overload "
+                        "streams of 10^5 jobs (fewer seams, same carry "
+                        "approximation)")
     p.add_argument("--backlog-gate", type=int, default=0,
                    help="evaluate the backlog-gated HYBRID scheduler: "
                         "when fewer than N jobs are pending, play FIFO "
@@ -114,6 +123,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "FIFO tie there and keeps the learned policy "
                         "where backlogs are deep. Flat configs, policy "
                         "row only")
+    p.add_argument("--stall-guard", dest="stall_guard", default=True,
+                   action="store_true",
+                   help="break eval-time place<->preempt argmax cycles by "
+                        "masking preempt actions after the legitimate "
+                        "zero-dt activity bound (preemptive configs; "
+                        "default ON — the measured config-1p drain "
+                        "deadlock, BASELINE.md)")
+    p.add_argument("--no-stall-guard", dest="stall_guard",
+                   action="store_false",
+                   help="disable the guard (A/B the raw argmax replay; a "
+                        "preemptive policy may then deadlock at <100% "
+                        "completion — the completion guard will flag it)")
     return p
 
 
@@ -151,6 +172,12 @@ def main(argv: list[str] | None = None) -> dict:
     if args.stitch_window_jobs is not None and not args.full_trace:
         sys.exit("--stitch-window-jobs applies to --full-trace stitched "
                  "replay only")
+    if args.stitch_drain_jobs != 1 and not args.full_trace:
+        sys.exit("--stitch-drain-jobs applies to --full-trace stitched "
+                 "replay only")
+    if args.stitch_drain_jobs < 1:
+        sys.exit("--stitch-drain-jobs must be >= 1 (each deep-backlog "
+                 "window must free at least one job-table row)")
     if args.backlog_gate < 0:
         sys.exit("--backlog-gate must be >= 0 (a negative gate would "
                  "silently run ungated)")
@@ -160,6 +187,15 @@ def main(argv: list[str] | None = None) -> dict:
                  "--full-trace policy tables (the hierarchical action "
                  "space has no single FIFO fall-through action; "
                  "--baselines-only has no policy row)")
+    if not args.stall_guard and (args.baselines_only or args.fairness
+                                 or cfg.n_pods > 1
+                                 or cfg.preempt_len == 0):
+        sys.exit("--no-stall-guard applies to flat PREEMPTIVE configs' "
+                 "policy rows (per-window, --full-trace, and flat --pbt "
+                 "members): the guard only ever masks preempt actions, "
+                 "so it is a no-op elsewhere, and the fairness path "
+                 "does not plumb it; refusing beats silently changing "
+                 "nothing)")
 
     if args.baselines_only:
         _, windows, _, _, _, _, _ = build_stack(cfg)
@@ -229,7 +265,9 @@ def main(argv: list[str] | None = None) -> dict:
                                    percentiles=PERCENTILES
                                    if args.percentiles else None,
                                    env_params=stitch_params,
-                                   backlog_gate=args.backlog_gate)
+                                   backlog_gate=args.backlog_gate,
+                                   stall_guard=args.stall_guard,
+                                   drain_completions=args.stitch_drain_jobs)
     else:
         eval_windows = None
         if args.eval_windows is not None and \
@@ -249,7 +287,8 @@ def main(argv: list[str] | None = None) -> dict:
                             include_random=not args.no_random,
                             percentiles=PERCENTILES if args.percentiles
                             else None,
-                            backlog_gate=args.backlog_gate)
+                            backlog_gate=args.backlog_gate,
+                            stall_guard=args.stall_guard)
     print(format_report(report), file=sys.stderr)
     out = {k: v for k, v in report.items() if isinstance(v, (int, float))}
     if "percentiles" in report:
