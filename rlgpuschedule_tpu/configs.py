@@ -29,6 +29,12 @@ class ExperimentConfig:
                    "philly-proxy", "pai-proxy"] = "synthetic"
     trace_path: str | None = None
     trace_load: float = 1.1             # proxy traces: offered load target
+    # generated traces (synthetic / *-proxy): pin the SOURCE trace size in
+    # jobs. None = sized to one window-streaming pass over the env batch
+    # (window_jobs * max(n_envs, 8), floored at 1024/4096). The north-star
+    # full-Philly run pins this at 100k+ so "the whole trace" is explicit
+    # rather than implied by the batch geometry.
+    source_jobs: int | None = None
     arrival_rate: float = 0.08          # synthetic: jobs/sec
     mean_duration: float = 600.0        # synthetic: log-normal mean
     window_jobs: int = 64               # jobs per episode window (max_jobs)

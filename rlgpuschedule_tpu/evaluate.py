@@ -41,6 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "of the checkpointed policy — e.g. evaluate a "
                         "load-1.1-trained policy on a load-1.6 overload "
                         "stream)")
+    p.add_argument("--source-jobs", type=int, default=None,
+                   help="generated traces: pin the evaluation source "
+                        "trace size in jobs (e.g. a 100k-job held-out "
+                        "stream for --full-trace)")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--n-envs", type=int, default=None)
     # cluster-shape overrides — MUST match the training run when restoring
@@ -147,6 +151,7 @@ def main(argv: list[str] | None = None) -> dict:
     over = {k: v for k, v in
             {"trace": args.trace, "trace_path": args.trace_path,
              "trace_load": args.trace_load, "seed": args.seed,
+             "source_jobs": args.source_jobs,
              "n_envs": args.n_envs, "n_nodes": args.n_nodes,
              "gpus_per_node": args.gpus_per_node,
              "window_jobs": args.window_jobs, "queue_len": args.queue_len,

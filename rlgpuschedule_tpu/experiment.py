@@ -40,6 +40,10 @@ def load_source_trace(cfg: ExperimentConfig, n_jobs: int | None = None,
                       seed: int | None = None) -> ArrayTrace:
     """The full source trace this experiment schedules."""
     seed = cfg.seed if seed is None else seed
+    if cfg.trace in ("synthetic", "philly-proxy", "pai-proxy"):
+        # cfg.source_jobs pins GENERATED traces only (its documented
+        # scope); a CSV load is the file's own size (n_jobs caps it)
+        n_jobs = n_jobs or cfg.source_jobs
     if cfg.trace == "synthetic":
         n = n_jobs or max(cfg.window_jobs * max(cfg.n_envs, 8), 1024)
         return gen_poisson_trace(cfg.arrival_rate, n, seed,

@@ -106,6 +106,18 @@ class TestTrainCLI:
         assert summary["pbt_events"] >= 1
         assert all(np.isfinite(summary["final_fitness"]))
 
+    def test_source_jobs_override(self):
+        # --source-jobs pins the generated source trace size explicitly
+        # (the north-star run trains on a 100k+-job trace by contract,
+        # not as a side effect of n_envs * window_jobs)
+        from rlgpuschedule_tpu.configs import CONFIGS
+        from rlgpuschedule_tpu.experiment import load_source_trace
+        args = train_cli.build_parser().parse_args(
+            ["--config", "ppo-mlp-synth64", "--source-jobs", "2048"])
+        cfg = train_cli.apply_overrides(CONFIGS["ppo-mlp-synth64"], args)
+        assert cfg.source_jobs == 2048
+        assert load_source_trace(cfg).num_jobs == 2048
+
     def test_algo_hparam_overrides(self):
         # --lr/--ent-coef/--n-steps/--n-epochs/--n-minibatches land in the
         # active algo's config; PPO-only knobs are rejected for A2C
