@@ -158,6 +158,12 @@ def main(argv: list[str] | None = None) -> dict:
              "horizon": args.horizon, "obs_kind": args.obs_kind,
              "drain_frac": args.drain_frac}.items() if v is not None}
     cfg = dataclasses.replace(cfg, **over)
+    if args.source_jobs is not None:
+        if args.source_jobs <= 0:
+            sys.exit("--source-jobs must be positive")
+        if cfg.trace in ("philly", "pai"):
+            sys.exit("--source-jobs sizes GENERATED traces; a CSV trace "
+                     "is its file's own size (refusing the silent no-op)")
 
     from .eval import (baseline_jct_table, fairness_report, format_fairness,
                        format_report, full_trace_report, jct_report)

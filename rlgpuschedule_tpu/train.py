@@ -270,7 +270,17 @@ def main(argv: list[str] | None = None) -> dict:
     if args.keep_best and not (args.eval_every and args.ckpt_dir):
         sys.exit("--keep-best requires --eval-every (the probe that "
                  "defines 'best') and --ckpt-dir (where best/ lives)")
+    if args.eval_probe != "auto" and not args.eval_every:
+        sys.exit("--eval-probe selects the --eval-every probe's regime; "
+                 "without --eval-every no probe runs and the flag would "
+                 "be a silent no-op")
     cfg = apply_overrides(CONFIGS[args.config], args)
+    if args.source_jobs is not None:
+        if args.source_jobs <= 0:
+            sys.exit("--source-jobs must be positive")
+        if cfg.trace in ("philly", "pai"):
+            sys.exit("--source-jobs sizes GENERATED traces; a CSV trace "
+                     "is its file's own size (refusing the silent no-op)")
 
     import contextlib
 

@@ -13,7 +13,13 @@ from rlgpuschedule_tpu.utils import (MetricsLogger, SectionTimer,
                                      ThroughputMeter)
 
 FAST = ["--iterations", "2", "--n-envs", "4", "--n-nodes", "2",
-        "--gpus-per-node", "4", "--window-jobs", "16", "--log-every", "1"]
+        "--gpus-per-node", "4", "--window-jobs", "16", "--log-every", "1",
+        # suite-speed: the CLI tests exercise mechanics (flags, logging,
+        # checkpoint/resume), not learning — shrink the compiled programs
+        # (preset n_steps=128/epochs=4 cost multi-second XLA compiles per
+        # distinct shape on the 1-core CI host)
+        "--horizon", "64", "--queue-len", "4", "--n-steps", "8",
+        "--n-epochs", "1", "--n-minibatches", "2"]
 
 
 class TestMetricsLogger:
@@ -102,7 +108,9 @@ class TestTrainCLI:
             ["--config", "hier-pbt-member", "--pbt", "--n-pop", "2",
              "--pbt-ready", "1", "--iterations", "2", "--n-envs", "4",
              "--n-nodes", "4", "--gpus-per-node", "4",
-             "--window-jobs", "16", "--log-every", "1"])
+             "--window-jobs", "16", "--log-every", "1",
+             "--horizon", "48", "--queue-len", "4", "--n-steps", "8",
+             "--n-epochs", "1", "--n-minibatches", "2"])
         assert summary["pbt_events"] >= 1
         assert all(np.isfinite(summary["final_fitness"]))
 
@@ -244,7 +252,7 @@ class TestEvaluateCLI:
                         "--ckpt-dir", ckpt_dir, "--ckpt-every", "2"])
         report = evaluate_cli.main(
             ["--config", "ppo-mlp-synth64", "--n-envs", "4",
-             "--n-nodes", "2", "--gpus-per-node", "4",
+             "--n-nodes", "2", "--gpus-per-node", "4", "--queue-len", "4",
              "--window-jobs", "16", "--horizon", "64", "--max-steps", "64",
              "--no-random", "--ckpt-dir", ckpt_dir, "--eval-windows", "2"])
         assert np.isfinite(report["policy"])
@@ -257,10 +265,13 @@ class TestEvaluateCLI:
         # then restore + replay the fittest member against the baselines
         ckpt_dir = str(tmp_path / "pop")
         small = ["--n-envs", "4", "--n-nodes", "4", "--gpus-per-node", "4",
-                 "--window-jobs", "16", "--horizon", "48"]
+                 "--window-jobs", "16", "--horizon", "48",
+                 "--queue-len", "4"]
+        train_small = [*small, "--n-steps", "8", "--n-epochs", "1",
+                       "--n-minibatches", "2"]   # train-CLI-only knobs
         train_cli.main(
             ["--config", "hier-pbt-member", "--pbt", "--n-pop", "2",
-             "--pbt-ready", "1", "--iterations", "2", *small,
+             "--pbt-ready", "1", "--iterations", "2", *train_small,
              "--log-every", "0", "--ckpt-dir", ckpt_dir,
              "--ckpt-every", "2"])
         report = evaluate_cli.main(
@@ -277,7 +288,7 @@ class TestEvaluateCLI:
         common = ["--config", "ppo-mlp-preempt", "--n-envs", "4",
                   "--no-random", "--n-nodes", "2", "--gpus-per-node", "4",
                   "--window-jobs", "16", "--horizon", "64",
-                  "--max-steps", "64"]
+                  "--queue-len", "4", "--max-steps", "64"]
         guarded = evaluate_cli.main(common)
         assert guarded["stall_guard"] is True
         raw = evaluate_cli.main(common + ["--no-stall-guard"])
