@@ -117,6 +117,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "file under this directory")
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--ckpt-every", type=int, default=50)
+    p.add_argument("--ckpt-keep", type=int, default=None,
+                   help="retain the last N periodic checkpoints (default "
+                        "3). Measured round 5: per-window probes do not "
+                        "rank full-trace quality, so keep a SERIES and "
+                        "select post-hoc with select_checkpoint against "
+                        "a held-out validation stream instead of "
+                        "trusting the probe's single best")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint from --ckpt-dir")
     p.add_argument("--profile-dir", default=None,
@@ -274,6 +281,12 @@ def main(argv: list[str] | None = None) -> dict:
         sys.exit("--eval-probe selects the --eval-every probe's regime; "
                  "without --eval-every no probe runs and the flag would "
                  "be a silent no-op")
+    if args.ckpt_keep is not None:
+        if args.ckpt_keep < 1:
+            sys.exit("--ckpt-keep must be >= 1")
+        if not args.ckpt_dir:
+            sys.exit("--ckpt-keep requires --ckpt-dir (nothing is "
+                     "retained without one)")
     cfg = apply_overrides(CONFIGS[args.config], args)
     if args.source_jobs is not None:
         if args.source_jobs <= 0:
@@ -290,7 +303,8 @@ def main(argv: list[str] | None = None) -> dict:
     if args.ckpt_dir:
         from .checkpoint import Checkpointer
         import os
-        ckpt = Checkpointer(os.path.abspath(args.ckpt_dir))
+        ckpt = Checkpointer(os.path.abspath(args.ckpt_dir),
+                            max_to_keep=args.ckpt_keep or 3)
 
     with contextlib.ExitStack() as stack:
         csv_logger = stack.enter_context(

@@ -114,6 +114,30 @@ class TestTrainCLI:
         assert summary["pbt_events"] >= 1
         assert all(np.isfinite(summary["final_fitness"]))
 
+    def test_select_checkpoint_ranks_retained_series(self, tmp_path):
+        # --ckpt-keep retains a checkpoint SERIES; select_checkpoint ranks
+        # it by full-trace JCT on a held-out validation stream and emits
+        # the argmin step (round-5 finding: per-window probes do not rank
+        # full-trace quality, so selection must use the deliverable's own
+        # metric on a third stream)
+        from rlgpuschedule_tpu import select_checkpoint
+        ckpt_dir = str(tmp_path / "ckpt")
+        train_cli.main(["--config", "ppo-mlp-synth64", *FAST,
+                        "--ckpt-dir", ckpt_dir, "--ckpt-every", "1",
+                        "--ckpt-keep", "2"])
+        out = select_checkpoint.main(
+            ["--config", "ppo-mlp-synth64", "--ckpt-dir", ckpt_dir,
+             "--n-envs", "4", "--n-nodes", "2", "--gpus-per-node", "4",
+             "--window-jobs", "16", "--queue-len", "4", "--horizon", "64",
+             "--val-jobs", "48", "--val-seed", "77"])
+        assert len(out["ranking"]) == 2
+        assert out["step"] in [s for _, s in out["ranking"]]
+        assert out["val_ratio"] == out["ranking"][0][0]
+        with pytest.raises(SystemExit, match="training seed"):
+            select_checkpoint.main(
+                ["--config", "ppo-mlp-synth64", "--ckpt-dir", ckpt_dir,
+                 "--val-seed", "0"])
+
     def test_source_jobs_override(self):
         # --source-jobs pins the generated source trace size explicitly
         # (the north-star run trains on a 100k+-job trace by contract,
