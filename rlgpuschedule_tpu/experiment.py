@@ -190,6 +190,8 @@ class Experiment:
     key: jax.Array
     source: Any = None       # full source ArrayTrace (window streaming)
     window_cursor: int = 0   # first window index of the current env batch
+    train_step_raw: Callable | None = None   # unjitted (for run_fused)
+    _fused_jit: Callable | None = None       # lazy; jit caches per length
 
     @staticmethod
     def build(cfg: ExperimentConfig, axis_name: str | None = None,
@@ -223,16 +225,59 @@ class Experiment:
                     "wraps it in shard_map over the mesh axis")
             # state and carry are replaced every iteration in run(), so
             # donating them halves live copies in the benchmarked hot loop
-            step_fn = jax.jit(step_fn, donate_argnums=(0, 1))
+            jit_step = jax.jit(step_fn, donate_argnums=(0, 1))
+        else:
+            jit_step = step_fn
         return Experiment(cfg=cfg, env_params=env_params, windows=windows,
                           traces=traces, net=net, apply_fn=apply_fn,
-                          train_state=train_state, train_step=step_fn,
-                          carry=carry, key=key, source=source)
+                          train_state=train_state, train_step=jit_step,
+                          carry=carry, key=key, source=source,
+                          train_step_raw=step_fn)
 
     @property
     def steps_per_iteration(self) -> int:
         algo_cfg = self.cfg.ppo if self.cfg.algo == "ppo" else self.cfg.a2c
         return algo_cfg.n_steps * self.cfg.n_envs
+
+    def run_fused(self, iterations: int):
+        """Run ``iterations`` train steps as ONE on-device program — a
+        ``lax.scan`` over the train step, the Podracer outer loop taken
+        all the way (SURVEY.md §7 hard part (d): per-step host↔device
+        sync at zero). Under the TPU tunnel every dispatch is a remote
+        RPC, so the per-iteration host loop of :meth:`run` bounds
+        sustained throughput by RPC latency, not chip time; one fused
+        dispatch removes that bound (and is how ``bench.py`` measures the
+        chip rather than the tunnel). No logging / eval / checkpoint /
+        window-streaming hooks run inside — use :meth:`run` when you need
+        them. Returns the LAST iteration's metrics."""
+        if self._fused_jit is None:
+            step = self.train_step_raw
+            if step is None:
+                raise ValueError("run_fused needs the raw step "
+                                 "(Experiment.build stores it)")
+
+            def many(state, carry, traces, keys):
+                def body(c, sk):
+                    s, ca = c
+                    s, ca, _ = step(s, ca, traces, sk)
+                    return (s, ca), None
+
+                (state, carry), _ = jax.lax.scan(
+                    body, (state, carry), keys[:-1])
+                # final step outside the scan returns its metrics without
+                # stacking [k] metric arrays for the whole run
+                state, carry, metrics = step(state, carry, traces,
+                                             keys[-1])
+                return state, carry, metrics
+
+            # one wrapper; jax.jit itself caches one compile per distinct
+            # keys length — no second cache layer needed
+            self._fused_jit = jax.jit(many, donate_argnums=(0, 1))
+        self.key, sub = jax.random.split(self.key)
+        keys = jax.random.split(sub, iterations)
+        self.train_state, self.carry, metrics = self._fused_jit(
+            self.train_state, self.carry, self.traces, keys)
+        return metrics
 
     def _cut_windows(self, cursor: int) -> None:
         """Re-cut the env windows at tiling position ``cursor`` (same

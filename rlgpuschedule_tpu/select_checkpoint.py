@@ -32,6 +32,10 @@ def build_parser() -> argparse.ArgumentParser:
                     "held-out validation stream.")
     p.add_argument("--ckpt-dir", required=True)
     p.add_argument("--config", default="ppo-mlp-synth64")
+    p.add_argument("--seed", type=int, default=None,
+                   help="the TRAINING seed the checkpointed run used "
+                        "(train --seed); the val-seed guard checks "
+                        "against this, not just the preset default")
     p.add_argument("--val-seed", type=int, default=1000,
                    help="seed of the VALIDATION stream (must differ from "
                         "both the training seed and the test seed)")
@@ -60,7 +64,8 @@ def main(argv: list[str] | None = None) -> dict:
     if args.config not in CONFIGS:
         sys.exit(f"unknown config {args.config!r}")
     over = {k: v for k, v in
-            {"n_envs": args.n_envs, "n_nodes": args.n_nodes,
+            {"seed": args.seed, "n_envs": args.n_envs,
+             "n_nodes": args.n_nodes,
              "gpus_per_node": args.gpus_per_node,
              "window_jobs": args.window_jobs, "queue_len": args.queue_len,
              "horizon": args.horizon, "obs_kind": args.obs_kind}.items()

@@ -13,6 +13,24 @@ from rlgpuschedule_tpu.experiment import (Experiment, build_env_params,
 from rlgpuschedule_tpu.algos import PPOConfig, A2CConfig
 
 
+def test_run_fused_advances_like_run():
+    """run_fused(k) is one on-device scan over the train step (the bench's
+    sustained-throughput mode): it must advance training (params change,
+    finite metrics) and leave the experiment reusable by the host loop."""
+    import numpy as np
+    import jax
+
+    cfg = small(CONFIGS["ppo-mlp-synth64"])
+    exp = Experiment.build(cfg)
+    before = jax.tree.leaves(exp.train_state.params)[0].copy()
+    metrics = exp.run_fused(3)
+    assert all(np.isfinite(float(v)) for v in metrics)
+    after = jax.tree.leaves(exp.train_state.params)[0]
+    assert not np.allclose(np.asarray(before), np.asarray(after))
+    out = exp.run(iterations=1)       # host loop still works afterwards
+    assert out["iterations"] == 1
+
+
 def small(cfg: ExperimentConfig, **kw) -> ExperimentConfig:
     """Shrink a preset for CPU testing."""
     return dataclasses.replace(

@@ -41,7 +41,11 @@ class TestHelpersSingleProcess:
 
 def test_dryrun_multihost_2proc():
     """The real gate: 2 fresh processes, cross-process psum + PBT gather.
-    Raises on rank failure, fingerprint disagreement, or timeout."""
+    Raises on rank failure, fingerprint disagreement, or timeout.
+    2 devices per rank (not 4): the boundary being tested is the PROCESS
+    boundary — the collective crosses it identically at any per-rank
+    device count, and the smaller per-rank mesh halves the worker's XLA
+    compile on the 1-core CI host."""
     import __graft_entry__ as ge
 
-    ge.dryrun_multihost(n_processes=2, devices_per_process=4)
+    ge.dryrun_multihost(n_processes=2, devices_per_process=2)
