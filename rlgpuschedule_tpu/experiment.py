@@ -255,6 +255,15 @@ class Experiment:
             if step is None:
                 raise ValueError("run_fused needs the raw step "
                                  "(Experiment.build stores it)")
+            if self.train_step is self.train_step_raw:
+                # built with jit=False — the shard_map/axis_name path
+                # (build() directs that path to dp.shard_map_train);
+                # jitting the raw step here would hit an unbound
+                # collective axis at trace time with an opaque error
+                raise ValueError(
+                    "run_fused supports the plain jitted single-program "
+                    "build; a jit=False/axis_name experiment runs its "
+                    "step under parallel.dp.shard_map_train instead")
 
             def many(state, carry, traces, keys):
                 def body(c, sk):
@@ -339,7 +348,8 @@ class Experiment:
             ckpt=None, ckpt_every: int = 0,
             eval_every: int = 0,
             eval_fn: "Callable[[int], dict] | None" = None,
-            eval_logger: Callable[[int, dict], None] | None = None) -> dict:
+            eval_logger: Callable[[int, dict], None] | None = None,
+            fused_chunk: int = 1) -> dict:
         """Run the host training loop; returns summary metrics. Pass a
         ``checkpoint.Checkpointer`` + cadence to persist while training.
 
@@ -347,16 +357,51 @@ class Experiment:
         the last one) — the in-training quality probe (e.g. a held-out JCT
         replay); its rows go to ``eval_logger`` (NOT ``logger``: eval rows
         have a different schema than train rows and MetricsLogger pins one
-        schema per stream) and into the summary's ``eval_history``."""
+        schema per stream) and into the summary's ``eval_history``.
+
+        ``fused_chunk > 1`` dispatches that many train steps as ONE
+        on-device :meth:`run_fused` program between hook boundaries
+        (under the TPU tunnel each dispatch is a remote RPC — the chunk
+        amortizes it). Every log/eval/ckpt/resample cadence must be a
+        multiple of the chunk, so hooks fire exactly as in the per-step
+        loop; metrics logged at a boundary are the boundary ITERATION's
+        (identical stream semantics, coarser sampling grid)."""
         iterations = iterations or self.cfg.iterations
+        if fused_chunk > 1:
+            cadences = {"log_every": log_every,
+                        # ckpt_every is only a live cadence when a
+                        # checkpointer is attached (the CLI default is 50
+                        # even without --ckpt-dir)
+                        "ckpt_every": ckpt_every if ckpt is not None else 0,
+                        "eval_every": eval_every if eval_fn is not None
+                        else 0,
+                        "resample_every": self.cfg.resample_every,
+                        "iterations": iterations}
+            bad = {k: v for k, v in cadences.items()
+                   if v and v % fused_chunk}
+            if bad:
+                raise ValueError(
+                    f"fused_chunk={fused_chunk} must divide every active "
+                    f"cadence and the iteration count; offending: {bad}")
         history = []
         eval_history = []
         t0 = time.time()
-        for i in range(iterations):
-            self.key, sub = jax.random.split(self.key)
-            self.train_state, self.carry, metrics = self.train_step(
-                self.train_state, self.carry, self.traces, sub)
-            if log_every and (i % log_every == 0 or i == iterations - 1):
+        for i in range(0, iterations, fused_chunk) if fused_chunk > 1 \
+                else range(iterations):
+            if fused_chunk > 1:
+                i = i + fused_chunk - 1      # hooks see the chunk's last
+                metrics = self.run_fused(fused_chunk)
+            else:
+                self.key, sub = jax.random.split(self.key)
+                self.train_state, self.carry, metrics = self.train_step(
+                    self.train_state, self.carry, self.traces, sub)
+            # chunked boundaries sit at i = k*chunk - 1, so the phase-0
+            # form (i % L == 0) would never fire there; the (i+1) form is
+            # the same cadence shifted to boundary-aligned phase
+            log_hit = log_every and (
+                (i + 1) % log_every == 0 if fused_chunk > 1
+                else i % log_every == 0)
+            if log_every and (log_hit or i == iterations - 1):
                 m = {k: float(v) for k, v in metrics._asdict().items()}
                 history.append({"iteration": i, **m})
                 if logger is not None:

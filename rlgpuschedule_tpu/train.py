@@ -126,6 +126,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "trusting the probe's single best")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint from --ckpt-dir")
+    p.add_argument("--fused-chunk", type=int, default=1,
+                   help="dispatch N train steps as one on-device scan "
+                        "between hook boundaries (every active log/eval/"
+                        "ckpt/resample cadence must be a multiple of N). "
+                        "Under the TPU tunnel each dispatch is a remote "
+                        "RPC — chunking amortizes it. Single-run configs "
+                        "only (--pbt is refused: its exploit/explore "
+                        "interleaves host-side between steps)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the run")
     p.add_argument("--debug-nans", action="store_true",
@@ -384,8 +392,16 @@ def main(argv: list[str] | None = None) -> dict:
                     MetricsLogger(args.log_csv + ".eval.csv"
                                   if args.log_csv else None, echo=True)))
 
+        run_kw = {}
+        if args.fused_chunk > 1:
+            if args.pbt:
+                sys.exit("--fused-chunk applies to single-run configs "
+                         "(the PBT loop interleaves host-side exploit/"
+                         "explore between steps)")
+            run_kw["fused_chunk"] = args.fused_chunk
         out = exp.run(log_every=args.log_every, logger=logger,
-                      ckpt=ckpt, ckpt_every=args.ckpt_every, **eval_kw)
+                      ckpt=ckpt, ckpt_every=args.ckpt_every, **eval_kw,
+                      **run_kw)
 
         summary = {k: v for k, v in out.items() if k != "history"}
         if args.report and not args.pbt and cfg.n_pods == 1:

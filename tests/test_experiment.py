@@ -31,6 +31,27 @@ def test_run_fused_advances_like_run():
     assert out["iterations"] == 1
 
 
+def test_run_fused_chunked_hooks_fire_on_grid():
+    """run(fused_chunk=N): hooks fire on the same cadence grid as the
+    per-step loop (boundary-aligned phase), indivisible cadences are
+    refused, and training advances."""
+    import numpy as np
+    import pytest
+
+    cfg = small(CONFIGS["ppo-mlp-synth64"])
+    exp = Experiment.build(cfg)
+    rows = []
+    out = exp.run(iterations=8, log_every=4,
+                  logger=lambda i, m: rows.append(i), fused_chunk=4)
+    assert rows == [3, 7]                  # boundaries of the 4-cadence
+    assert out["iterations"] == 8
+    assert np.isfinite(out["env_steps_per_sec"])
+    with pytest.raises(ValueError, match="fused_chunk"):
+        exp.run(iterations=8, log_every=3, fused_chunk=4)
+    with pytest.raises(ValueError, match="fused_chunk"):
+        exp.run(iterations=6, fused_chunk=4)
+
+
 def small(cfg: ExperimentConfig, **kw) -> ExperimentConfig:
     """Shrink a preset for CPU testing."""
     return dataclasses.replace(
