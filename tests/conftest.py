@@ -33,4 +33,7 @@ import jax  # noqa: E402
 
 jax.config.update("jax_compilation_cache_dir",
                   os.environ["JAX_COMPILATION_CACHE_DIR"])
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# cache EVERY compile (default floor 1s, previously 0.5): the suite is
+# hundreds of small programs on a 1-core host — sub-second compiles in
+# aggregate are a large share of warm-run wall clock
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
