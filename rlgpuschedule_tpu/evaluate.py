@@ -168,6 +168,9 @@ def main(argv: list[str] | None = None) -> dict:
     from .eval import (baseline_jct_table, fairness_report, format_fairness,
                        format_report, full_trace_report, jct_report)
     from .experiment import Experiment, build_stack
+    from .utils.platform import enable_compile_cache
+
+    enable_compile_cache()
 
     if args.percentiles and (args.fairness or args.baselines_only
                              or args.pbt):

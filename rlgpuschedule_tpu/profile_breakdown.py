@@ -69,6 +69,9 @@ def main(argv: list[str] | None = None) -> dict:
     if args.cpu:
         from rlgpuschedule_tpu.utils.platform import force_cpu
         force_cpu(1)
+    from rlgpuschedule_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
 
     import jax
     import jax.numpy as jnp

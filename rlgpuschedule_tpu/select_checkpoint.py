@@ -88,7 +88,9 @@ def main(argv: list[str] | None = None) -> dict:
     from .experiment import Experiment, load_source_trace
     from .sim.core import validate_trace
     from .sim.schedulers import run_baseline
+    from .utils.platform import enable_compile_cache
 
+    enable_compile_cache()
     exp = Experiment.build(cfg)
     val = validate_trace(
         exp.env_params.sim,

@@ -306,6 +306,9 @@ def main(argv: list[str] | None = None) -> dict:
     import contextlib
 
     from .utils import MetricsLogger, profiling
+    from .utils.platform import enable_compile_cache
+
+    enable_compile_cache()
 
     ckpt = None
     if args.ckpt_dir:

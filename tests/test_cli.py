@@ -67,6 +67,26 @@ class TestMetricsLogger:
         assert "a" in t.report() and t.report()["a"] >= 0
 
 
+class TestCompileCache:
+    def test_enable_compile_cache_points_jax_at_the_dir(self, tmp_path,
+                                                        monkeypatch):
+        # CLI processes must reuse one persistent XLA cache (measured:
+        # the grid-CNN program build is ~10 min on this host, re-paid
+        # per process without it). Explicit env var wins; jax config and
+        # the subprocess-facing env var both end up set.
+        import jax
+        from rlgpuschedule_tpu.utils.platform import enable_compile_cache
+        prev = jax.config.jax_compilation_cache_dir
+        target = str(tmp_path / "cache")
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", target)
+        try:
+            assert enable_compile_cache() == target
+            assert jax.config.jax_compilation_cache_dir == target
+            assert os.environ["JAX_COMPILATION_CACHE_DIR"] == target
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+
+
 class TestTrainCLI:
     def test_list_configs(self, capsys):
         train_cli.main(["--list-configs"])
