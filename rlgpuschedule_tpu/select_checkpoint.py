@@ -55,6 +55,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--horizon", type=int, default=None)
     p.add_argument("--obs-kind", default=None,
                    choices=["flat", "grid", "graph"])
+    p.add_argument("--trace-load", type=float, default=None,
+                   help="proxy traces: offered load of the validation "
+                        "stream — match the TEST stream's load (round-5 "
+                        "measurement: a load-1.1-trained policy reads "
+                        "7.4x Tiresias on a 1.6x-overload 100k stream; "
+                        "selection must happen in the deliverable's "
+                        "regime)")
     return p
 
 
@@ -68,7 +75,8 @@ def main(argv: list[str] | None = None) -> dict:
              "n_nodes": args.n_nodes,
              "gpus_per_node": args.gpus_per_node,
              "window_jobs": args.window_jobs, "queue_len": args.queue_len,
-             "horizon": args.horizon, "obs_kind": args.obs_kind}.items()
+             "horizon": args.horizon, "obs_kind": args.obs_kind,
+             "trace_load": args.trace_load}.items()
             if v is not None}
     cfg = dataclasses.replace(CONFIGS[args.config], **over)
     if cfg.trace in ("philly", "pai"):

@@ -34,6 +34,12 @@ def enable_compile_cache(directory: str | None = None) -> str:
 
     jax.config.update("jax_compilation_cache_dir", directory)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        # floor-0 caching from every CLI run accumulates; cap with LRU
+        # eviction so the per-user dir stays bounded (~2 GiB)
+        jax.config.update("jax_compilation_cache_max_size", 2 * 1024 ** 3)
+    except AttributeError:
+        pass  # older jax: no size knob; the floor-0 policy still applies
     return directory
 
 
