@@ -8,7 +8,9 @@ Prints ONE JSON line:
 The reference publishes no number for this metric (BASELINE.json
 ``published = {}``), so ``vs_baseline`` is reported against the first
 recorded value of OUR implementation (BENCH_BASELINE_VALUE below, set from
-round 1); 1.0 means parity with that record.
+round 1); 1.0 means parity with that record. When the run's platform or
+measurement method differs from the record's, ``vs_baseline`` is null —
+the ratio would not be apples-to-apples (ADVICE r5).
 
 Runs the config-1 workload (PPO-MLP, 64-GPU cluster, synthetic Poisson
 trace — SURVEY.md §0) scaled to fill one chip: the fused rollout+update
@@ -29,9 +31,17 @@ import sys
 import time
 
 # First recorded value on the target chip (TPU v5 lite, round 1,
-# 2026-07-29): 67.93M env-steps/s/chip for the full fused PPO loop.
+# 2026-07-29): 67.93M env-steps/s/chip for the full fused PPO loop,
+# measured as k per-dispatch host-loop iterations per repeat. Round 5
+# changed WHAT is measured to one fused on-device scan per repeat
+# (method "fused-scan" below); no TPU record exists under that method
+# yet, so vs_baseline is null until one is recorded here — dividing a
+# fused-scan value by the per-dispatch record would conflate the method
+# change with real speedup (ADVICE r5).
 BENCH_BASELINE_VALUE: float | None = 67_931_471.7
 BENCH_BASELINE_PLATFORM = "tpu"
+BENCH_BASELINE_METHOD = "per-dispatch"
+BENCH_METHOD = "fused-scan"
 
 
 def tpu_healthy(timeout_s: float = 75.0, attempts: int = 3) -> bool:
@@ -147,21 +157,19 @@ def main() -> None:
         if (len(samples) >= min_repeats and spread < 0.15) \
                 or len(samples) >= max_repeats:
             break
-    vs = (value / BENCH_BASELINE_VALUE
-          if BENCH_BASELINE_VALUE and platform == BENCH_BASELINE_PLATFORM
-          else 1.0)
+    # comparable only when platform AND method match the baseline record;
+    # otherwise null — a ratio across either boundary would read as a
+    # speedup/regression that is really a measurement change
+    comparable = (BENCH_BASELINE_VALUE
+                  and platform == BENCH_BASELINE_PLATFORM
+                  and BENCH_METHOD == BENCH_BASELINE_METHOD)
+    vs = round(value / BENCH_BASELINE_VALUE, 3) if comparable else None
     print(json.dumps({
         "metric": f"ppo_env_steps_per_sec_per_chip[{platform}]",
-        # round 5 changed WHAT is measured: one fused on-device scan per
-        # repeat (sustained chip rate) instead of k per-dispatch host-loop
-        # iterations (rounds 1-4, bounded by tunnel-RPC latency).
-        # vs_baseline still divides by the round-1 per-dispatch record, so
-        # across that boundary it conflates the method change with real
-        # speedup — read it together with this tag.
-        "method": "fused-scan",
+        "method": BENCH_METHOD,
         "value": round(value, 1),
         "unit": "env-steps/s/chip",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": vs,
         "repeats": len(samples),
         "iters_per_repeat": iters_rep,
         "min": round(s[0], 1),

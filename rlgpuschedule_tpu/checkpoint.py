@@ -17,11 +17,28 @@ Layout per step: ``state/`` (params, opt_state, step, key — arrays) +
 """
 from __future__ import annotations
 
+import sys
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 from flax.training.train_state import TrainState
+
+
+class CheckpointRestoreError(RuntimeError):
+    """Every retained checkpoint step failed to restore (corruption /
+    truncation across the whole rotation window)."""
+
+
+def _fresh_copy(tree: Any) -> Any:
+    """Copy every restored array into a fresh device buffer. Orbax-restored
+    buffers must NOT be donated back into a jitted step (donate_argnums):
+    on the multi-device CPU backend that corrupts the heap (the seed's
+    restore-then-run resume tests segfaulted the whole suite). One jitted
+    copy decouples the training state from the restore machinery's
+    buffers; sharding is preserved (copy is elementwise)."""
+    return jax.jit(lambda t: jax.tree.map(jnp.copy, t))(tree)
 
 
 def _state_tree(state: TrainState, key: jax.Array | None,
@@ -55,6 +72,7 @@ class Checkpointer:
             directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True))
+        self.last_restored_step: int | None = None
 
     @property
     def directory(self) -> str:
@@ -106,24 +124,61 @@ class Checkpointer:
     def restore(self, template_state: TrainState,
                 template_key: jax.Array | None = None,
                 template_extra: Any | None = None,
-                step: int | None = None,
+                step: int | None = None, fallback: bool = True,
                 ) -> tuple[TrainState, jax.Array | None, Any, dict]:
         """Restore into the shape/dtype/sharding of ``template_state`` (a
         live state from the same model/optimizer build — its values are
         ignored). Pass ``template_key``/``template_extra`` iff they were
-        saved. Returns (state, key-or-None, extra-or-None, meta)."""
-        step = self.latest_step() if step is None else step
-        if step is None:
+        saved. Returns (state, key-or-None, extra-or-None, meta).
+
+        Integrity fallback: restoring the LATEST step (``step=None``)
+        verifies the step actually restores; a step whose files are
+        truncated/corrupted (or a partial dir left by a crash inside the
+        force-overwrite delete+save window) is skipped with a visible
+        stderr warning and the previous retained step is restored instead.
+        Only when EVERY retained step fails does this raise
+        :class:`CheckpointRestoreError`. An explicit ``step`` (or
+        ``fallback=False``) restores exactly that step and re-raises its
+        failure. ``self.last_restored_step`` records which step won.
+
+        The returned arrays live in fresh buffers (see :func:`_fresh_copy`)
+        so callers may hand them straight to a donating jitted step."""
+        if step is not None:
+            candidates = [step]
+        else:
+            candidates = sorted(self._mngr.all_steps(), reverse=True)
+        if not candidates:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}")
         template = _state_tree(template_state, template_key, template_extra)
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-        restored = self._mngr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
-                meta=ocp.args.JsonRestore()))
-        tree = restored["state"]
+        restored = None
+        errors: list[tuple[int, Exception]] = []
+        for i, s in enumerate(candidates):
+            try:
+                restored = self._mngr.restore(
+                    s,
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(abstract),
+                        meta=ocp.args.JsonRestore()))
+                self.last_restored_step = s
+                break
+            except Exception as e:   # orbax surfaces corruption as
+                errors.append((s, e))  # assorted exception types
+                if step is not None or not fallback:
+                    raise
+                if i + 1 < len(candidates):
+                    print(f"checkpoint: step {s} failed to restore "
+                          f"({type(e).__name__}: {str(e)[:200]}); "
+                          f"falling back to step {candidates[i + 1]}",
+                          file=sys.stderr, flush=True)
+        if restored is None:
+            raise CheckpointRestoreError(
+                f"all {len(candidates)} retained checkpoint steps under "
+                f"{self.directory} failed to restore: "
+                + "; ".join(f"step {s}: {type(e).__name__}"
+                            for s, e in errors)) from errors[-1][1]
+        tree = _fresh_copy(restored["state"])
         # TrainState is a flax struct (.replace); population MemberState is
         # a NamedTuple (._replace) — both checkpoint through the same path
         rep = getattr(template_state, "replace", None) or \

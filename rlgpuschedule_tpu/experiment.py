@@ -249,7 +249,14 @@ class Experiment:
         dispatch removes that bound (and is how ``bench.py`` measures the
         chip rather than the tunnel). No logging / eval / checkpoint /
         window-streaming hooks run inside — use :meth:`run` when you need
-        them. Returns the LAST iteration's metrics."""
+        them. Returns the LAST iteration's metrics.
+
+        RNG: ONE split of ``self.key`` is fanned out into ``iterations``
+        subkeys up front, whereas :meth:`run`'s per-step loop splits
+        ``self.key`` sequentially every iteration — the two derive
+        DIFFERENT key streams. A fused (or ``fused_chunk > 1``) run is
+        therefore deterministic and reproducible, but NOT bit-identical
+        to the same-seed per-step run."""
         if self._fused_jit is None:
             step = self.train_step_raw
             if step is None:
@@ -343,13 +350,34 @@ class Experiment:
             self._cut_windows(cursor)
         return meta
 
+    def scale_lr(self, scale: float) -> None:
+        """Swap the optimizer for one at ``scale`` × the config LR (the
+        watchdog's deterministic rollback decay). Rebinding ``tx`` changes
+        the TrainState's static treedef, so the next step re-traces — an
+        acceptable cost bounded by ``max_rollbacks``. Adam's moment state
+        is LR-independent, so the restored opt_state carries over."""
+        algo_cfg = self.cfg.ppo if self.cfg.algo == "ppo" else self.cfg.a2c
+        scaled = dataclasses.replace(algo_cfg, lr=algo_cfg.lr * scale)
+        if self.cfg.algo == "ppo":
+            tx = make_optimizer(scaled)
+        else:
+            from .algos.a2c import make_optimizer as a2c_opt
+            tx = a2c_opt(scaled)
+        self.train_state = self.train_state.replace(tx=tx)
+
+    def fold_key(self, n: int) -> None:
+        """Deterministically diverge the rollout RNG stream (watchdog
+        retry: replaying the restored key bit-exactly would re-sample the
+        trajectory that just diverged)."""
+        self.key = jax.random.fold_in(self.key, n)
+
     def run(self, iterations: int | None = None, log_every: int = 0,
             logger: Callable[[int, dict], None] | None = None,
             ckpt=None, ckpt_every: int = 0,
             eval_every: int = 0,
             eval_fn: "Callable[[int], dict] | None" = None,
             eval_logger: Callable[[int, dict], None] | None = None,
-            fused_chunk: int = 1) -> dict:
+            fused_chunk: int = 1, watchdog=None, injector=None) -> dict:
         """Run the host training loop; returns summary metrics. Pass a
         ``checkpoint.Checkpointer`` + cadence to persist while training.
 
@@ -364,9 +392,28 @@ class Experiment:
         (under the TPU tunnel each dispatch is a remote RPC — the chunk
         amortizes it). Every log/eval/ckpt/resample cadence must be a
         multiple of the chunk, so hooks fire exactly as in the per-step
-        loop; metrics logged at a boundary are the boundary ITERATION's
-        (identical stream semantics, coarser sampling grid)."""
+        loop; metrics logged at a boundary are the boundary ITERATION's.
+        NOTE: chunked and per-step runs derive their rollout RNG keys
+        DIFFERENTLY (see :meth:`run_fused`), so a ``fused_chunk > 1`` run
+        is deterministic but NOT bit-identical to the same-seed per-step
+        run.
+
+        ``watchdog`` (:class:`resilience.DivergenceWatchdog`, requires
+        ``ckpt``) checks each materialized iteration's metrics and rolls
+        back to the last good checkpoint on divergence — after a rollback
+        the replayed iterations are re-logged, so the history/CSV shows
+        the retry honestly. With ``fused_chunk > 1`` only chunk-boundary
+        metrics exist to check. ``injector``
+        (:class:`resilience.FaultInjector`) drives the fault-injection
+        hooks (``nan-grad`` after the matching iteration's step,
+        ``corrupt-ckpt`` after the matching iteration's save). A
+        :class:`resilience.DivergenceError` propagates to the caller once
+        the watchdog's rollback budget is exhausted."""
         iterations = iterations or self.cfg.iterations
+        if watchdog is not None and ckpt is None:
+            raise ValueError(
+                "watchdog rollback needs a checkpoint store; pass ckpt= "
+                "(and a ckpt_every cadence so rollbacks stay short)")
         if fused_chunk > 1:
             cadences = {"log_every": log_every,
                         # ckpt_every is only a live cadence when a
@@ -386,39 +433,56 @@ class Experiment:
         history = []
         eval_history = []
         t0 = time.time()
-        for i in range(0, iterations, fused_chunk) if fused_chunk > 1 \
-                else range(iterations):
+        stride = fused_chunk if fused_chunk > 1 else 1
+        if watchdog is not None and ckpt.latest_step() is None:
+            # guarantee a rollback target before the first periodic save
+            self.save_checkpoint(ckpt, meta={"iteration": -1})
+        i = 0
+        while i < iterations:
+            # hooks see the chunk's last iteration (== i when unchunked);
+            # chunked boundaries sit at b = k*chunk - 1, so the phase-0
+            # cadence form (b % L == 0) would never fire there; the (b+1)
+            # form is the same cadence shifted to boundary-aligned phase
+            b = i + stride - 1
             if fused_chunk > 1:
-                i = i + fused_chunk - 1      # hooks see the chunk's last
                 metrics = self.run_fused(fused_chunk)
             else:
                 self.key, sub = jax.random.split(self.key)
                 self.train_state, self.carry, metrics = self.train_step(
                     self.train_state, self.carry, self.traces, sub)
-            # chunked boundaries sit at i = k*chunk - 1, so the phase-0
-            # form (i % L == 0) would never fire there; the (i+1) form is
-            # the same cadence shifted to boundary-aligned phase
-            log_hit = log_every and (
-                (i + 1) % log_every == 0 if fused_chunk > 1
-                else i % log_every == 0)
-            if log_every and (log_hit or i == iterations - 1):
+            if injector is not None:
+                metrics = injector.poison_nan(self, b, metrics)
+            if watchdog is not None:
                 m = {k: float(v) for k, v in metrics._asdict().items()}
-                history.append({"iteration": i, **m})
+                reason = watchdog.check(m)
+                if reason is not None:
+                    event = watchdog.rollback(self, ckpt, b, reason)
+                    i = event.resume_iteration
+                    continue
+            log_hit = log_every and (
+                (b + 1) % log_every == 0 if fused_chunk > 1
+                else b % log_every == 0)
+            if log_every and (log_hit or b == iterations - 1):
+                m = {k: float(v) for k, v in metrics._asdict().items()}
+                history.append({"iteration": b, **m})
                 if logger is not None:
-                    logger(i, m)
+                    logger(b, m)
             if eval_fn is not None and eval_every and \
-                    ((i + 1) % eval_every == 0 or i == iterations - 1):
-                em = dict(eval_fn(i))
-                eval_history.append({"iteration": i, **em})
+                    ((b + 1) % eval_every == 0 or b == iterations - 1):
+                em = dict(eval_fn(b))
+                eval_history.append({"iteration": b, **em})
                 if eval_logger is not None:
-                    eval_logger(i, em)
+                    eval_logger(b, em)
             if ckpt is not None and ckpt_every and \
-                    ((i + 1) % ckpt_every == 0 or i == iterations - 1):
-                self.save_checkpoint(ckpt, meta={"iteration": i})
+                    ((b + 1) % ckpt_every == 0 or b == iterations - 1):
+                self.save_checkpoint(ckpt, meta={"iteration": b})
+                if injector is not None:
+                    injector.corrupt_after_save(ckpt, b)
             if self.cfg.resample_every and \
-                    (i + 1) % self.cfg.resample_every == 0 and \
-                    i != iterations - 1:
+                    (b + 1) % self.cfg.resample_every == 0 and \
+                    b != iterations - 1:
                 self.advance_windows()
+            i += stride
         jax.block_until_ready(self.train_state.params)
         wall = time.time() - t0
         total_env_steps = iterations * self.steps_per_iteration
@@ -427,6 +491,9 @@ class Experiment:
                "env_steps_per_sec": total_env_steps / wall,
                "window_cursor": self.window_cursor,
                "history": history}
+        if watchdog is not None:
+            out["rollbacks"] = watchdog.n_rollbacks
+            out["rollback_events"] = [e.as_dict() for e in watchdog.events]
         if eval_history:
             out["eval_history"] = eval_history
         return out
@@ -578,22 +645,57 @@ class PopulationExperiment:
         self.controller.load_state_dict((meta or {}).get("pbt_controller"))
         return meta
 
+    def scale_lr(self, scale: float) -> None:
+        """Watchdog rollback decay for the population: per-member LRs live
+        in the traced :class:`~parallel.population.HParams` (not the
+        optimizer), so the decay is one array multiply — no re-trace."""
+        self.hparams = self.hparams._replace(lr=self.hparams.lr * scale)
+
+    def fold_key(self, n: int) -> None:
+        """Deterministically diverge every member's rollout RNG stream
+        (watchdog retry — same contract as :meth:`Experiment.fold_key`)."""
+        self.keys = jax.vmap(lambda k: jax.random.fold_in(k, n))(self.keys)
+
     def run(self, iterations: int | None = None, log_every: int = 0,
             logger: Callable[[int, dict], None] | None = None,
-            ckpt=None, ckpt_every: int = 0) -> dict:
+            ckpt=None, ckpt_every: int = 0,
+            watchdog=None, injector=None) -> dict:
         """Train the population; PBT exploit/explore fires every
         ``controller.cfg.ready_iters`` iterations. Returns summary metrics
-        including per-member final fitness and the PBT event log."""
+        including per-member final fitness and the PBT event log.
+
+        ``watchdog`` (requires ``ckpt``) handles only the CATASTROPHIC
+        divergence case — every member non-finite, nobody left to re-seed
+        from — by rolling the whole population back to the last good
+        checkpoint; a single diverged member is PBT's job (exploit treats
+        non-finite fitness as dead and re-seeds it from the best member).
+        ``injector`` drives ``nan-grad`` (member poisoning; spec
+        ``rank`` = member index) and ``corrupt-ckpt`` faults."""
         iterations = iterations or self.cfg.iterations
+        if watchdog is not None and ckpt is None:
+            raise ValueError(
+                "watchdog rollback needs a checkpoint store; pass ckpt= "
+                "(and a ckpt_every cadence so rollbacks stay short)")
         split_all = jax.jit(jax.vmap(lambda k: jax.random.split(k)))
         history = []
         t0 = time.time()
-        for i in range(iterations):
+        if watchdog is not None and ckpt.latest_step() is None:
+            self.save_checkpoint(ckpt, meta={"iteration": -1})
+        i = 0
+        while i < iterations:
             both = split_all(self.keys)
             self.keys, subs = both[:, 0], both[:, 1]
             self.states, self.carries, metrics = self.pop_step(
                 self.states, self.carries, self.traces, subs, self.hparams)
+            if injector is not None:
+                metrics = injector.poison_nan_member(self, i, metrics)
             fitness = metrics.mean_reward
+            if watchdog is not None:
+                reason = watchdog.check_population(fitness)
+                if reason is not None:
+                    event = watchdog.rollback(self, ckpt, i, reason)
+                    i = event.resume_iteration
+                    continue
             self.controller.record(fitness)
             out = self.controller.maybe_update(i, self.states, self.hparams)
             if out is not None:
@@ -612,13 +714,20 @@ class PopulationExperiment:
             if ckpt is not None and ckpt_every and \
                     ((i + 1) % ckpt_every == 0 or i == iterations - 1):
                 self.save_checkpoint(ckpt, meta={"iteration": i})
+                if injector is not None:
+                    injector.corrupt_after_save(ckpt, i)
+            i += 1
         jax.block_until_ready(self.states.params)
         wall = time.time() - t0
         total_env_steps = iterations * self.steps_per_iteration
-        return {"wall_s": wall, "iterations": iterations,
-                "env_steps": total_env_steps,
-                "env_steps_per_sec": total_env_steps / wall,
-                "final_fitness": [float(f) for f in
-                                  self.controller.mean_fitness],
-                "pbt_events": len(self.controller.history),
-                "history": history}
+        out = {"wall_s": wall, "iterations": iterations,
+               "env_steps": total_env_steps,
+               "env_steps_per_sec": total_env_steps / wall,
+               "final_fitness": [float(f) for f in
+                                 self.controller.mean_fitness],
+               "pbt_events": len(self.controller.history),
+               "history": history}
+        if watchdog is not None:
+            out["rollbacks"] = watchdog.n_rollbacks
+            out["rollback_events"] = [e.as_dict() for e in watchdog.events]
+        return out

@@ -35,6 +35,45 @@ from ..algos.rollout import RolloutCarry
 from .mesh import DATA_AXIS, env_sharded, replicated
 
 
+def shard_map_compat(fn, mesh, in_specs, out_specs, check: bool = True):
+    """``shard_map`` across jax versions: newer jax exposes it at top
+    level with a ``check_vma`` kwarg; 0.4/0.5 at
+    ``jax.experimental.shard_map`` with the same knob named
+    ``check_rep``. The seed imported only the new location, so the whole
+    explicit-collective path was an ImportError on the pinned jax."""
+    try:
+        from jax import shard_map as sm
+        kw = {"check_vma": check}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+        kw = {"check_rep": check}
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def put_global(tree: Any, sharding: NamedSharding) -> Any:
+    """``device_put`` every leaf of ``tree`` onto ``sharding``, including
+    in MULTI-CONTROLLER runs. Plain ``jax.device_put`` refuses a host
+    value destined for a sharding that spans non-addressable devices (the
+    multihost mesh — this is what killed the 2-process dryrun's ranks);
+    there each process instead contributes its addressable shards of its
+    local copy via ``jax.make_array_from_process_local_data``. Leaves
+    that are already global (non-fully-addressable) jax.Arrays — e.g.
+    traces assembled by ``multihost.global_traces`` — are passed through
+    untouched, since their shards cannot be re-placed host-side."""
+    import numpy as np
+
+    def put(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return x
+        if sharding.is_fully_addressable:
+            return jax.device_put(x, sharding)
+        arr = np.asarray(x)
+        return jax.make_array_from_process_local_data(
+            sharding, arr, arr.shape)
+
+    return jax.tree.map(put, tree)
+
+
 def carry_sharding_prefix(mesh: Mesh) -> RolloutCarry:
     """RolloutCarry sharding prefix-tree: PRNG key replicated, everything
     env-batched split over ``data``."""
@@ -50,10 +89,10 @@ def put_carry(mesh: Mesh, carry: RolloutCarry,
     shard_map path stacks per-shard keys over ``data``)."""
     env = env_sharded(mesh)
     return RolloutCarry(
-        env_state=jax.device_put(carry.env_state, env),
-        obs=jax.device_put(carry.obs, env),
-        mask=jax.device_put(carry.mask, env),
-        key=jax.device_put(carry.key, key_sharding or replicated(mesh)))
+        env_state=put_global(carry.env_state, env),
+        obs=put_global(carry.obs, env),
+        mask=put_global(carry.mask, env),
+        key=put_global(carry.key, key_sharding or replicated(mesh)))
 
 
 def _check_env_divisible(mesh: Mesh, traces) -> None:
@@ -79,9 +118,9 @@ def shard_train(mesh: Mesh, train_step: Callable, train_state, carry,
                      out_shardings=(rep, carry_sh, rep),
                      donate_argnums=(0, 1))
     return (jitted,
-            jax.device_put(train_state, rep),
+            put_global(train_state, rep),
             put_carry(mesh, carry),
-            jax.device_put(traces, env))
+            put_global(traces, env))
 
 
 def shard_map_train(mesh: Mesh, train_step_axis: Callable, train_state,
@@ -99,7 +138,6 @@ def shard_map_train(mesh: Mesh, train_step_axis: Callable, train_state,
     GSPMD path."""
     _check_env_divisible(mesh, traces)
     n_data = mesh.shape[DATA_AXIS]
-    from jax import shard_map
 
     env_spec, rep_spec = P(DATA_AXIS), P()
     carry_spec = RolloutCarry(env_state=env_spec, obs=env_spec,
@@ -112,11 +150,11 @@ def shard_map_train(mesh: Mesh, train_step_axis: Callable, train_state,
             lambda m: jax.lax.pmean(m, DATA_AXIS), metrics)
         return state, local._replace(key=local.key[None]), metrics
 
-    jitted = jax.jit(shard_map(
+    jitted = jax.jit(shard_map_compat(
         wrapped, mesh=mesh,
         in_specs=(rep_spec, carry_spec, env_spec, rep_spec),
         out_specs=(rep_spec, carry_spec, rep_spec),
-        check_vma=False), donate_argnums=(0, 1))
+        check=False), donate_argnums=(0, 1))
 
     keys = jax.random.split(jnp.asarray(carry.key), n_data)
     carry = carry._replace(key=keys)

@@ -40,24 +40,72 @@ from .mesh import make_mesh
 
 
 def initialize(coordinator_address: str, num_processes: int,
-               process_id: int) -> None:
-    """``jax.distributed.initialize`` for one process of a multi-host run.
+               process_id: int, connect_attempts: int = 3,
+               backoff_s: float = 1.0) -> None:
+    """``jax.distributed.initialize`` for one process of a multi-host run,
+    with retry-with-backoff on the coordinator connect.
 
     Call before ANY device access, one call per process. On real TPU pods
     the three arguments are normally auto-detected from the TPU metadata
     (pass them only for non-standard setups); on CPU (CI / this machine)
     they are required, and the gloo cross-process collectives backend is
     selected — without it the CPU client has no cross-host transfer
-    implementation and collective lowering fails."""
+    implementation and collective lowering fails.
+
+    Retry: a restarted gang races its own coordinator (rank 0 may come up
+    seconds after its peers try to connect — exactly the supervised
+    restart-from-checkpoint path), so a failed connect is retried
+    ``connect_attempts`` times with exponential backoff (``backoff_s``,
+    doubled per attempt) before the final failure propagates."""
     # set unconditionally — probing the backend state here would itself
     # initialize a backend (making jax.distributed.initialize refuse), and
     # the gloo selection only affects a CPU backend anyway; if a backend
     # IS already initialized, distributed.initialize raises its own clear
     # error below
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    import sys
+    import time
+    for attempt in range(max(connect_attempts, 1)):
+        try:
+            # all three identifiers are explicit, so cluster auto-detect
+            # has nothing to contribute — and on a host with libtpu
+            # visible but no metadata server (this rig) the TPU detection
+            # path stalls each rank ~100s in metadata-fetch retries
+            # before the coordinator even starts (measured; it timed the
+            # 2-proc dryrun out at jax's 300s initialization_timeout)
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id,
+                cluster_detection_method="deactivate")
+            return
+        except Exception as e:
+            if attempt + 1 >= max(connect_attempts, 1):
+                raise
+            wait = backoff_s * 2 ** attempt
+            print(f"multihost: coordinator connect attempt "
+                  f"{attempt + 1}/{connect_attempts} failed "
+                  f"({type(e).__name__}: {str(e)[:120]}); retrying in "
+                  f"{wait:.1f}s", file=sys.stderr, flush=True)
+            time.sleep(wait)
+
+
+def warmup_collectives() -> None:
+    """Form the cross-process collective communicator NOW, while every
+    rank is still in lockstep from ``jax.distributed.initialize``.
+
+    The gloo context for a device clique is created lazily at the first
+    dispatched collective, with a hard ~30s KV rendezvous window. Left
+    to the first real train step, that window races each rank's XLA
+    compile of the step program — on a loaded 1-core CI host the compile
+    skew between two ranks exceeded it and the faster rank died with
+    ``Gloo context initialization failed: DEADLINE_EXCEEDED`` (measured,
+    2026-08-04 tier-1 run). This barrier's trivial all-device psum
+    compiles in well under the window on every rank, and on exit all
+    ranks resume simultaneously — so the heavy compiles that follow
+    start aligned instead of wherever coordinator-connect jitter left
+    them."""
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("rlgpuschedule_tpu.warmup")
 
 
 def global_mesh(n_pop: int = 1) -> Mesh:

@@ -3,24 +3,96 @@ without a real cluster"; VERDICT r2 next-round #4).
 
 Run as ``python -m rlgpuschedule_tpu.parallel.multihost_worker --coordinator
 127.0.0.1:PORT --num-procs 2 --proc-id K --devices-per-proc 4`` — normally
-via ``__graft_entry__.dryrun_multihost``, which spawns all ranks and
-checks their reports agree. Each rank:
+via ``__graft_entry__.dryrun_multihost`` (plain gate) or
+``__graft_entry__.dryrun_multihost_supervised`` (failure-recovery gate),
+which spawn all ranks and check their reports agree. Each rank:
 
-1. ``multihost.initialize`` (jax.distributed + gloo CPU collectives),
+1. ``multihost.initialize`` (jax.distributed + gloo CPU collectives,
+   retry-with-backoff on the coordinator connect),
 2. builds the global (pop, data) mesh spanning both processes,
 3. cuts ONLY its own env windows of a config-1-style trace
    (per-host trace sharding) and assembles the global Trace with
    ``multihost.global_traces``,
-4. runs 2 GSPMD DP train steps (gradient psum crosses the process
-   boundary) and prints a params fingerprint — identical across ranks iff
-   the cross-process allreduce works,
+4. runs ``--steps`` GSPMD DP train steps (gradient psum crosses the
+   process boundary) and prints a params fingerprint — identical across
+   ranks iff the cross-process allreduce works,
 5. runs a PBT exploit gather over a pop axis that spans the two processes
-   (the cross-host weight copy, DCN-analog) and prints its fingerprint.
+   (the cross-host weight copy, DCN-analog) and prints its fingerprint
+   (skippable with ``--no-pbt-check``).
+
+Resilience surface (the supervised dryrun drives all of it):
+
+- ``--heartbeat-dir`` — beat a per-rank file before every step
+  (``resilience.HeartbeatWriter``); the supervisor's timeout watchdog
+  reads them.
+- ``--ckpt-dir`` — after every completed step, atomically persist this
+  rank's params + opt_state to a PER-STEP ``rank<r>.step<k>.npz`` (+ a
+  ``rank<r>.step`` latest-step sidecar the supervisor can read without
+  numpy; last ``_CKPT_KEEP`` step files retained). Plain npz, not Orbax:
+  each rank saves only its own replicated copy, so no cross-process
+  checkpoint barrier can deadlock a gang that is already dying.
+- ``--resume-step S`` — restore ``rank<r>.step<S>.npz`` and continue
+  from step S (the supervisor passes the minimum completed step across
+  ranks; a rank that durably got further must restore the OLDER state,
+  or the gang resumes from divergent replicated params).
+- ``--fault kill-rank@T:rank=R`` — rank R dies un-gracefully right
+  before step T, i.e. before entering the step's collective, so every
+  rank's last durable checkpoint is step T-1 or later.
+
+Per-step rollout keys are ``PRNGKey(i)`` — a restarted rank replays the
+same key sequence from its resume step, so all ranks (including the
+respawned one) converge to identical fingerprints.
 """
 from __future__ import annotations
 
 import argparse
 import os
+
+
+_CKPT_KEEP = 4   # per-rank retained step files (bounds disk, >= any lag)
+
+
+def _save_rank_ckpt(ckpt_dir: str, rank: int, state, completed: int) -> None:
+    """Persist this rank's state as a PER-STEP file plus a latest-step
+    sidecar. Per-step files are load-bearing: when a rank dies mid-step,
+    its PEERS may have durably completed one step more, so the supervisor
+    resumes the gang from the MINIMUM completed step — and a rank that is
+    ahead must restore that older state, not its own newest (restoring
+    divergent per-rank states into a replicated-params DP program
+    assembles garbage global arrays; measured as NaN metrics two steps
+    after a resume)."""
+    import glob
+    import jax
+    import numpy as np
+    leaves = [np.asarray(x) for x in
+              jax.tree.leaves((state.params, state.opt_state))]
+    path = os.path.join(ckpt_dir, f"rank{rank}.step{completed}.npz")
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, completed=completed,
+             **{f"leaf{j}": l for j, l in enumerate(leaves)})
+    os.replace(tmp, path)
+    side = os.path.join(ckpt_dir, f"rank{rank}.step")
+    with open(side + ".tmp", "w") as f:
+        f.write(str(completed))
+    os.replace(side + ".tmp", side)
+    kept = sorted(glob.glob(os.path.join(ckpt_dir, f"rank{rank}.step*.npz")),
+                  key=lambda p: int(p.rsplit("step", 1)[1].split(".")[0]))
+    for old in kept[:-_CKPT_KEEP]:
+        os.remove(old)
+
+
+def _load_rank_ckpt(ckpt_dir: str, rank: int, state, step: int):
+    """Restore this rank's state AT exactly ``step`` (the gang-wide
+    minimum the supervisor chose)."""
+    import jax
+    import numpy as np
+    path = os.path.join(ckpt_dir, f"rank{rank}.step{step}.npz")
+    data = np.load(path)
+    template = (state.params, state.opt_state)
+    treedef = jax.tree.structure(template)
+    leaves = [data[f"leaf{j}"] for j in range(treedef.num_leaves)]
+    params, opt_state = jax.tree.unflatten(treedef, leaves)
+    return state.replace(params=params, opt_state=opt_state)
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -29,10 +101,28 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--num-procs", type=int, required=True)
     ap.add_argument("--proc-id", type=int, required=True)
     ap.add_argument("--devices-per-proc", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=2)
+    ap.add_argument("--heartbeat-dir", default=None)
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--resume-step", type=int, default=-1,
+                    help=">= 0: restore rank<r>.npz from --ckpt-dir and "
+                         "continue from this step")
+    ap.add_argument("--fault", action="append", default=None,
+                    help="kill-rank@T:rank=R (resilience.parse_fault)")
+    ap.add_argument("--no-pbt-check", action="store_true",
+                    help="skip the PBT exploit-gather section (the "
+                         "supervised dryrun tests recovery, not PBT)")
     args = ap.parse_args(argv)
 
-    # platform pins must precede ANY jax device access
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    # platform pins must precede ANY jax device access. The env var alone
+    # is NOT enough here: ``python -m`` imports the package __init__s
+    # (which import jax) before main() runs, and jax snapshots
+    # JAX_PLATFORMS at import — so mutate the live config too. Measured
+    # without it (2026-08-04): with the rig's libtpu importable, the
+    # first device access probed the TPU plugin through minutes of
+    # metadata-fetch retries on ONE rank, desyncing the gang past gloo's
+    # ~30s rendezvous window.
+    os.environ["JAX_PLATFORMS"] = "cpu"   # for any subprocess readers
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
@@ -40,12 +130,33 @@ def main(argv: list[str] | None = None) -> None:
             f"{args.devices_per_proc}").strip()
 
     import jax
+    jax.config.update("jax_platforms", "cpu")
+    # no persistent compile cache in multi-controller workers: RELOADING
+    # a serialized gloo-collective executable segfaults the rank on this
+    # jax (measured; __graft_entry__'s spawners scrub the env var too,
+    # but the config can arrive set via enable_compile_cache's export)
+    jax.config.update("jax_compilation_cache_dir", None)
     from rlgpuschedule_tpu.parallel import multihost
+    from rlgpuschedule_tpu.resilience import (FaultInjector, HeartbeatWriter,
+                                              parse_fault)
+
+    injector = FaultInjector([parse_fault(s) for s in args.fault or []])
+    hb = (HeartbeatWriter(args.heartbeat_dir, args.proc_id)
+          if args.heartbeat_dir else None)
+    if hb is not None:
+        # beat BEFORE the first jax import: startup (backend init +
+        # distributed connect + XLA compiles) is the longest beat-free
+        # stretch of the whole run, and without this the supervisor's
+        # missing-file grace window has to cover all of it
+        hb.beat(-1)
+    if args.ckpt_dir:
+        os.makedirs(args.ckpt_dir, exist_ok=True)
 
     multihost.initialize(args.coordinator, args.num_procs, args.proc_id)
     n_global = args.num_procs * args.devices_per_proc
     assert len(jax.devices()) == n_global, \
         f"expected {n_global} global devices, got {len(jax.devices())}"
+    multihost.warmup_collectives()
 
     import jax.numpy as jnp
     import numpy as np
@@ -96,11 +207,23 @@ def main(argv: list[str] | None = None) -> None:
                       np.asarray(local_carry.mask[:1]))
     state = TrainState.create(apply_fn=net.apply, params=params,
                               tx=make_optimizer(cfg))
+    start = 0
+    if args.ckpt_dir and args.resume_step >= 0:
+        start = args.resume_step
+        state = _load_rank_ckpt(args.ckpt_dir, args.proc_id, state, start)
+        print(f"MULTIHOST_RESUMED proc={args.proc_id} step={start}",
+              flush=True)
     step, state, carry, traces = dp.shard_train(
         mesh, make_ppo_step(apply_fn, env_params, cfg), state, carry, traces)
-    for i in range(2):
+    for i in range(start, args.steps):
+        injector.maybe_kill_rank(args.proc_id, i)
+        if hb is not None:
+            hb.beat(i)
         state, carry, metrics = step(state, carry, traces,
                                      jax.random.PRNGKey(i))
+        if args.ckpt_dir:
+            jax.block_until_ready(state.params)
+            _save_rank_ckpt(args.ckpt_dir, args.proc_id, state, i + 1)
     jax.block_until_ready(state.params)
     assert all(bool(jnp.isfinite(v)) for v in metrics), metrics
     # replicated-params fingerprint: identical across ranks iff the
@@ -109,6 +232,9 @@ def main(argv: list[str] | None = None) -> None:
                    for l in jax.tree.leaves(state.params)))
     print(f"MULTIHOST_DP_OK proc={args.proc_id} fingerprint={fp:.6f}",
           flush=True)
+
+    if args.no_pbt_check:
+        return
 
     # ---- PBT exploit gather across the process boundary ------------------
     pop_mesh = multihost.global_mesh(n_pop=args.num_procs)

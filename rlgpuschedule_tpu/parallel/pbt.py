@@ -46,17 +46,31 @@ def exploit_explore(rng: np.random.Generator, fitness: np.ndarray,
                     hparams: HParams, cfg: PBTConfig) -> PBTDecision:
     """Truncation-selection PBT: bottom ``exploit_frac`` of members copy a
     uniformly-chosen top-``exploit_frac`` member and perturb its hparams.
-    NaN fitness (a diverged member) ranks as worst, so divergence is culled
-    by exploit instead of copied (argsort would otherwise sort NaN last =
-    top)."""
-    fitness = np.where(np.isnan(fitness), -np.inf, fitness)
+
+    Non-finite fitness (a diverged member) is treated as DEAD, not merely
+    last-ranked: every dead member is forcibly exploited — re-seeded from
+    the single best finite member — regardless of the truncation quota,
+    and winners are drawn from finite members only. Ranking NaN as worst
+    (the previous behavior) still let dead members survive whenever more
+    members diverged than the bottom quantile holds, and could copy FROM
+    a dead member when divergence reached the top quantile. With no
+    finite member at all there is nobody to re-seed from; dead members
+    then keep their state (the population watchdog's whole-run rollback
+    is the recovery for that case)."""
+    raw = np.asarray(fitness, np.float64)
+    finite = np.isfinite(raw)
+    fitness = np.where(finite, raw, -np.inf)
     n = len(fitness)
     k = max(int(np.floor(n * cfg.exploit_frac)), 1) if n > 1 else 0
     order = np.argsort(fitness)           # ascending: losers first
-    losers, winners = order[:k], order[n - k:] if k else order[:0]
+    losers = order[:k]
+    winners = order[n - k:][finite[order[n - k:]]] if k else order[:0]
     src = np.arange(n)
-    if k:
+    if k and len(winners):
         src[losers] = rng.choice(winners, size=k)
+    if finite.any() and not finite.all():
+        # dead members re-seed from the best member, quota or not
+        src[~finite] = int(np.argmax(fitness))
     exploited = src != np.arange(n)
 
     hp = jax.tree.map(np.asarray, hparams)

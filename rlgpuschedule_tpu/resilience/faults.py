@@ -1,0 +1,157 @@
+"""Deterministic fault injection (SURVEY.md §5 "fault injection").
+
+Faults are fully specified by their spec string — no RNG — so a faulted
+run is exactly reproducible and the recovery paths can be asserted in
+tier-1 CPU tests. Three kinds, one per recovery path:
+
+- ``nan-grad@K`` — at training iteration K, poison the train state's
+  params with NaN and flag the iteration's metrics non-finite, as if one
+  PPO update had applied a NaN gradient. Recovery: the
+  :class:`~.watchdog.DivergenceWatchdog` rolls back to the last good
+  checkpoint. Against a PBT population, ``:rank=M`` selects WHICH member
+  is poisoned (default 0); recovery is then the exploit re-seed of the
+  dead member (``parallel.pbt.exploit_explore``).
+- ``corrupt-ckpt@K`` — truncate the data files of the checkpoint written
+  at iteration K, right after its save. Recovery:
+  ``Checkpointer.restore``'s integrity fallback to the previous retained
+  step.
+- ``kill-rank@T[:rank=R]`` — multihost: rank R calls ``os._exit`` right
+  before train step T (before entering the step's collective, so every
+  rank's last durable checkpoint is step T-1). Recovery: the supervised
+  dryrun's heartbeat/exit watchdog restarts the gang from checkpoint.
+  Refused by the single-process train CLI.
+
+Each fault fires exactly once (a rollback that replays iteration K must
+not re-trip the same injected fault, or no retry could ever succeed).
+"""
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import sys
+from typing import Any
+
+FAULT_KINDS = ("nan-grad", "corrupt-ckpt", "kill-rank")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    kind: str       # one of FAULT_KINDS
+    at: int         # iteration (nan-grad/corrupt-ckpt) or train step (kill)
+    rank: int = 0   # kill-rank: process rank; nan-grad vs PBT: member index
+    fired: bool = False
+
+
+def parse_fault(spec: str) -> FaultSpec:
+    """Parse ``kind@N[:rank=R]`` (e.g. ``nan-grad@3``,
+    ``kill-rank@2:rank=1``). Raises ValueError with the offending spec."""
+    body = spec.strip()
+    rank = 0
+    if ":" in body:
+        body, _, opt = body.partition(":")
+        key, _, val = opt.partition("=")
+        if key.strip() != "rank" or not val.strip().lstrip("-").isdigit():
+            raise ValueError(f"bad fault option {opt!r} in {spec!r} "
+                             f"(expected rank=R)")
+        rank = int(val)
+    kind, sep, at = body.partition("@")
+    kind = kind.strip()
+    if kind not in FAULT_KINDS or not sep or not at.strip().isdigit():
+        raise ValueError(
+            f"bad fault spec {spec!r}; expected kind@N[:rank=R] with kind "
+            f"in {FAULT_KINDS}")
+    return FaultSpec(kind=kind, at=int(at), rank=rank)
+
+
+def corrupt_checkpoint(directory: str, step: int) -> int:
+    """Truncate every data blob of checkpoint ``step`` under ``directory``
+    to half its size (the truncated-save / partial-write failure mode).
+    Returns the number of files corrupted; raises if the step dir has no
+    data files (corrupting nothing would silently test nothing)."""
+    step_dir = os.path.join(directory, str(step))
+    targets = [f for pat in ("state/d/*", "state/ocdbt.process_*/d/*")
+               for f in glob.glob(os.path.join(step_dir, pat))
+               if os.path.isfile(f)]
+    if not targets:
+        raise FileNotFoundError(
+            f"no checkpoint data files under {step_dir} to corrupt")
+    for f in targets:
+        with open(f, "r+b") as fh:
+            fh.truncate(max(os.path.getsize(f) // 2, 1))
+    return len(targets)
+
+
+class FaultInjector:
+    """Host-side injection hooks called from the training loops. Holds the
+    parsed specs; every hook is a no-op unless a not-yet-fired spec
+    matches the current iteration/step, so an attached injector costs
+    nothing on the healthy path."""
+
+    def __init__(self, specs: list[FaultSpec]):
+        self.specs = list(specs)
+
+    def _take(self, kind: str, at: int) -> FaultSpec | None:
+        for s in self.specs:
+            if s.kind == kind and s.at == at and not s.fired:
+                s.fired = True
+                return s
+        return None
+
+    def poison_nan(self, exp: Any, iteration: int, metrics: Any) -> Any:
+        """``nan-grad`` hook (single-run ``Experiment``): poison the whole
+        param tree + the iteration's metrics. Returns the (possibly
+        poisoned) metrics NamedTuple."""
+        import jax
+        import jax.numpy as jnp
+        if self._take("nan-grad", iteration) is None:
+            return metrics
+        print(f"fault-injection: nan-grad at iteration {iteration} "
+              f"(params poisoned)", file=sys.stderr, flush=True)
+        exp.train_state = exp.train_state.replace(
+            params=jax.tree.map(lambda x: x * jnp.nan,
+                                exp.train_state.params))
+        return metrics._replace(
+            total_loss=metrics.total_loss * jnp.nan)
+
+    def poison_nan_member(self, pop: Any, iteration: int,
+                          metrics: Any) -> Any:
+        """``nan-grad`` hook (``PopulationExperiment``): poison ONE
+        member's param rows (spec ``rank`` = member index) and its metric
+        column — the dead-member input to the PBT exploit re-seed."""
+        import jax
+        import jax.numpy as jnp
+        spec = self._take("nan-grad", iteration)
+        if spec is None:
+            return metrics
+        m = spec.rank
+        print(f"fault-injection: nan-grad at iteration {iteration} "
+              f"member {m}", file=sys.stderr, flush=True)
+        pop.states = pop.states._replace(
+            params=jax.tree.map(
+                lambda x: x.at[m].set(jnp.nan), pop.states.params))
+        return metrics._replace(
+            mean_reward=metrics.mean_reward.at[m].set(jnp.nan))
+
+    def corrupt_after_save(self, ckpt: Any, iteration: int) -> None:
+        """``corrupt-ckpt`` hook: right after the periodic save at
+        ``iteration``, corrupt the just-saved (latest) step's files."""
+        if self._take("corrupt-ckpt", iteration) is None:
+            return
+        ckpt.wait()          # the async save must be on disk to corrupt
+        step = ckpt.latest_step()
+        n = corrupt_checkpoint(ckpt.directory, step)
+        print(f"fault-injection: corrupted checkpoint step {step} "
+              f"({n} files) after iteration {iteration}",
+              file=sys.stderr, flush=True)
+
+    def maybe_kill_rank(self, rank: int, step: int) -> None:
+        """``kill-rank`` hook (multihost worker): rank ``rank`` dies
+        un-gracefully right before train step ``step``."""
+        for s in self.specs:
+            if s.kind == "kill-rank" and s.at == step and s.rank == rank \
+                    and not s.fired:
+                s.fired = True
+                print(f"fault-injection: rank {rank} dying before step "
+                      f"{step}", file=sys.stderr, flush=True)
+                os._exit(17)

@@ -1,0 +1,74 @@
+"""Per-rank heartbeat files + timeout watchdog (SURVEY.md §5 "Failure
+detection" — the multihost half).
+
+A dead rank leaves its peers silently blocked inside a collective; no
+exception ever surfaces on the survivors. Liveness therefore has to be
+observed from OUTSIDE the gang: each rank atomically rewrites a tiny
+``rank<r>.hb`` file before every train step, and the supervisor
+(``__graft_entry__.dryrun_multihost_supervised``) declares a rank dead
+when its file goes stale past the timeout (or its process exits
+non-zero, the fast path) and restarts the gang from checkpoint.
+
+Files, not sockets: the supervisor and workers already share a
+filesystem, an atomic rename is crash-consistent, and a stale file is
+exactly the failure signature we need — a hung rank stops renaming.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+
+class HeartbeatWriter:
+    """One rank's side: ``beat(step)`` atomically rewrites the rank file
+    with the current step and wall time."""
+
+    def __init__(self, directory: str, rank: int):
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, f"rank{rank}.hb")
+        self._tmp = self.path + ".tmp"
+
+    def beat(self, step: int) -> None:
+        with open(self._tmp, "w") as f:
+            f.write(f"{step} {time.time()}")
+        os.replace(self._tmp, self.path)   # atomic on POSIX
+
+
+class HeartbeatMonitor:
+    """Supervisor's side: which ranks have not beaten within
+    ``timeout_s``? A rank with no file yet is judged against the
+    monitor's start time (grace for slow jax/XLA startup)."""
+
+    def __init__(self, directory: str, n_ranks: int, timeout_s: float):
+        self.directory = directory
+        self.n_ranks = n_ranks
+        self.timeout_s = timeout_s
+        self._t0 = time.time()
+
+    def restart(self) -> None:
+        """Re-arm the missing-file grace window (call when the gang is
+        (re)spawned)."""
+        self._t0 = time.time()
+
+    def read(self) -> dict[int, tuple[int, float]]:
+        """{rank: (last step, beat wall time)} for ranks that have beaten."""
+        out = {}
+        for r in range(self.n_ranks):
+            path = os.path.join(self.directory, f"rank{r}.hb")
+            try:
+                with open(path) as f:
+                    step_s, ts_s = f.read().split()
+                out[r] = (int(step_s), float(ts_s))
+            except (FileNotFoundError, ValueError):
+                continue   # not yet written, or mid-rename torn read
+        return out
+
+    def stale_ranks(self, now: float | None = None) -> list[int]:
+        now = time.time() if now is None else now
+        beats = self.read()
+        stale = []
+        for r in range(self.n_ranks):
+            last = beats.get(r, (None, self._t0))[1]
+            if now - last > self.timeout_s:
+                stale.append(r)
+        return stale

@@ -1,0 +1,128 @@
+"""Divergence watchdog (SURVEY.md §5 "Failure detection").
+
+A NaN in one PPO update silently poisons every later iteration — the run
+keeps "training" on garbage until someone reads the curves. The watchdog
+checks each iteration's materialized metrics (the one host sync the
+per-iteration loop already pays when logging) and, on divergence, rolls
+the experiment back to the last good Orbax checkpoint with a
+deterministically decayed learning rate; after ``max_rollbacks`` it gives
+up with a clean :class:`DivergenceError` instead of looping forever.
+
+Determinism: the decay schedule is ``lr_decay ** n_rollbacks`` and the
+retry's RNG stream is ``fold_in(restored_key, n_rollbacks)`` — a faulted
+run recovers the same way every time it is replayed.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import sys
+from typing import Any
+
+
+class DivergenceError(RuntimeError):
+    """The run diverged more times than ``max_rollbacks`` allows."""
+
+
+@dataclasses.dataclass
+class RollbackEvent:
+    """One recovery action, as it appears in the run summary/log."""
+    iteration: int           # iteration whose metrics tripped the check
+    restored_step: int | None  # checkpoint step actually restored
+    resume_iteration: int    # loop index training resumes from
+    n_rollback: int          # 1-based rollback counter
+    lr_scale: float          # LR multiplier now in effect
+    reason: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class DivergenceWatchdog:
+    """Per-iteration divergence detection + checkpoint rollback.
+
+    >>> wd = DivergenceWatchdog(max_rollbacks=3)
+    >>> out = exp.run(..., ckpt=ckpt, ckpt_every=10, watchdog=wd)
+
+    ``check`` flags (a) any non-finite metric and (b) a total_loss whose
+    magnitude exceeds ``blowup_factor`` × the running loss EMA — the
+    "finite but exploding" precursor a plain NaN check misses. The EMA
+    resets on rollback so the retried trajectory is judged afresh.
+    """
+
+    def __init__(self, max_rollbacks: int = 3, lr_decay: float = 0.5,
+                 blowup_factor: float = 1e4, ema_decay: float = 0.9):
+        if max_rollbacks < 0:
+            raise ValueError(f"max_rollbacks must be >= 0, "
+                             f"got {max_rollbacks}")
+        self.max_rollbacks = max_rollbacks
+        self.lr_decay = lr_decay
+        self.blowup_factor = blowup_factor
+        self.ema_decay = ema_decay
+        self.n_rollbacks = 0
+        self.events: list[RollbackEvent] = []
+        self._loss_ema: float | None = None
+
+    def check(self, metrics: dict[str, float]) -> str | None:
+        """Reason string if this iteration's metrics look divergent, else
+        None (and the loss EMA advances)."""
+        for k, v in metrics.items():
+            if not math.isfinite(v):
+                return f"non-finite {k}={v}"
+        loss = metrics.get("total_loss")
+        if loss is not None:
+            if self._loss_ema is not None and \
+                    abs(loss) > self.blowup_factor * max(
+                        abs(self._loss_ema), 1.0):
+                return (f"loss blow-up: |total_loss|={abs(loss):.3g} > "
+                        f"{self.blowup_factor:g} x ema "
+                        f"{abs(self._loss_ema):.3g}")
+            self._loss_ema = (loss if self._loss_ema is None else
+                              self.ema_decay * self._loss_ema
+                              + (1 - self.ema_decay) * loss)
+        return None
+
+    def check_population(self, fitness: Any) -> str | None:
+        """Population variant: a SINGLE dead member is PBT's job (exploit
+        re-seeds it from the best member); the watchdog only rolls back
+        the catastrophic case where NO member has finite fitness — there
+        is nobody left to re-seed from."""
+        vals = [float(v) for v in fitness]
+        if vals and not any(math.isfinite(v) for v in vals):
+            return f"all {len(vals)} members non-finite (fitness={vals})"
+        return None
+
+    def rollback(self, exp: Any, ckpt: Any, iteration: int,
+                 reason: str) -> RollbackEvent:
+        """Roll ``exp`` back to the last good checkpoint (integrity
+        fallback included — a corrupted latest step falls through to the
+        previous retained one), decay the LR, fold the rollback count
+        into the RNG key, and return the event. Raises
+        :class:`DivergenceError` once ``max_rollbacks`` is exhausted."""
+        if self.n_rollbacks >= self.max_rollbacks:
+            raise DivergenceError(
+                f"diverged at iteration {iteration} ({reason}) after "
+                f"{self.n_rollbacks} rollback(s); max_rollbacks="
+                f"{self.max_rollbacks} exhausted — giving up cleanly")
+        self.n_rollbacks += 1
+        # settle async saves first: the most recent periodic save may
+        # still be in flight, and rolling back past it would silently
+        # lose good iterations
+        ckpt.wait()
+        meta = exp.restore_checkpoint(ckpt)
+        scale = self.lr_decay ** self.n_rollbacks
+        exp.scale_lr(scale)
+        exp.fold_key(self.n_rollbacks)
+        resume = int((meta or {}).get("iteration", -1)) + 1
+        self._loss_ema = None
+        event = RollbackEvent(
+            iteration=iteration, restored_step=ckpt.last_restored_step,
+            resume_iteration=resume, n_rollback=self.n_rollbacks,
+            lr_scale=scale, reason=reason)
+        self.events.append(event)
+        print(f"watchdog: {reason} at iteration {iteration} -> rolled "
+              f"back to checkpoint step {event.restored_step} (resume "
+              f"iteration {resume}, lr x{scale:g}, rollback "
+              f"{self.n_rollbacks}/{self.max_rollbacks})",
+              file=sys.stderr, flush=True)
+        return event

@@ -36,9 +36,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="the TRAINING seed the checkpointed run used "
                         "(train --seed); the val-seed guard checks "
                         "against this, not just the preset default")
-    p.add_argument("--val-seed", type=int, default=1000,
+    p.add_argument("--val-seed", type=int, default=2000,
                    help="seed of the VALIDATION stream (must differ from "
-                        "both the training seed and the test seed)")
+                        "the training seed, from training seed + 1000 — "
+                        "the --eval-every probe's default held-out "
+                        "stream — and from the test seed)")
+    p.add_argument("--test-seed", type=int, default=None,
+                   help="seed of the TEST stream the chosen step will be "
+                        "measured on (evaluate's stream); pass it so the "
+                        "validation/test disjointness this selector "
+                        "promises is actually enforced, not assumed")
     p.add_argument("--val-jobs", type=int, default=1024,
                    help="validation stream length in jobs")
     p.add_argument("--stitch-drain-jobs", type=int, default=8,
@@ -88,6 +95,19 @@ def main(argv: list[str] | None = None) -> dict:
     if args.val_seed == cfg.seed:
         sys.exit("--val-seed equals the config's training seed; selection "
                  "on the training distribution is not validation")
+    if args.val_seed == cfg.seed + 1000:
+        sys.exit("--val-seed equals training seed + 1000, the in-training "
+                 "--eval-every probe's default held-out seed; a --keep-best "
+                 "run already optimized checkpoint choice against that "
+                 "stream, so selecting on it is not validation either")
+    if args.test_seed is not None:
+        if args.test_seed == args.val_seed:
+            sys.exit("--test-seed equals --val-seed; selection and "
+                     "measurement must run on disjoint streams")
+        if args.test_seed == cfg.seed:
+            sys.exit("--test-seed equals the config's training seed; "
+                     "measuring on the training distribution is not a "
+                     "test")
 
     import os
 

@@ -141,6 +141,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "functional design has no data races to detect, so "
                         "NaN-poisoning is the remaining numeric hazard; "
                         "fails fast with a traceback at the first NaN)")
+    # resilience (SURVEY.md §5 "Failure detection"): the divergence
+    # watchdog + deterministic fault injection, demonstrable end to end
+    p.add_argument("--max-rollbacks", type=int, default=None,
+                   help="attach the divergence watchdog: a non-finite or "
+                        "exploding iteration rolls the run back to the "
+                        "last good checkpoint with a decayed LR, giving "
+                        "up cleanly after N rollbacks (requires "
+                        "--ckpt-dir; see resilience.DivergenceWatchdog)")
+    p.add_argument("--fault", action="append", default=None,
+                   metavar="KIND@N[:rank=R]",
+                   help="deterministic fault injection (repeatable): "
+                        "nan-grad@K poisons params+metrics at iteration "
+                        "K (PBT: rank=M selects the member), "
+                        "corrupt-ckpt@K truncates the checkpoint saved "
+                        "at iteration K. kill-rank is refused here "
+                        "(multihost only — drive it with __graft_entry__."
+                        "dryrun_multihost_supervised)")
     p.add_argument("--report", action="store_true",
                    help="print the JCT-vs-baselines table after training "
                         "(single-run, non-hierarchical configs)")
@@ -295,6 +312,27 @@ def main(argv: list[str] | None = None) -> dict:
         if not args.ckpt_dir:
             sys.exit("--ckpt-keep requires --ckpt-dir (nothing is "
                      "retained without one)")
+    faults = []
+    if args.fault:
+        from .resilience import parse_fault
+        try:
+            faults = [parse_fault(s) for s in args.fault]
+        except ValueError as e:
+            sys.exit(str(e))
+        if any(f.kind == "kill-rank" for f in faults):
+            sys.exit("kill-rank is a multihost fault and this CLI is one "
+                     "process; drive it with "
+                     "__graft_entry__.dryrun_multihost_supervised")
+        if any(f.kind == "corrupt-ckpt" for f in faults) \
+                and not args.ckpt_dir:
+            sys.exit("--fault corrupt-ckpt requires --ckpt-dir (no "
+                     "checkpoint is ever written without one)")
+    if args.max_rollbacks is not None:
+        if args.max_rollbacks < 0:
+            sys.exit("--max-rollbacks must be >= 0")
+        if not args.ckpt_dir:
+            sys.exit("--max-rollbacks requires --ckpt-dir (rollback "
+                     "restores the last good checkpoint)")
     cfg = apply_overrides(CONFIGS[args.config], args)
     if args.source_jobs is not None:
         if args.source_jobs <= 0:
@@ -349,7 +387,9 @@ def main(argv: list[str] | None = None) -> dict:
             if ckpt is None:
                 sys.exit("--resume requires --ckpt-dir")
             meta = exp.restore_checkpoint(ckpt)
-            print(f"resumed from step {ckpt.latest_step()} ({meta})",
+            # last_restored_step, not latest_step: the integrity fallback
+            # may have restored an older retained step than the newest dir
+            print(f"resumed from step {ckpt.last_restored_step} ({meta})",
                   file=sys.stderr)
 
         eval_kw = {}
@@ -402,9 +442,22 @@ def main(argv: list[str] | None = None) -> dict:
                          "(the PBT loop interleaves host-side exploit/"
                          "explore between steps)")
             run_kw["fused_chunk"] = args.fused_chunk
-        out = exp.run(log_every=args.log_every, logger=logger,
-                      ckpt=ckpt, ckpt_every=args.ckpt_every, **eval_kw,
-                      **run_kw)
+        if args.max_rollbacks is not None:
+            from .resilience import DivergenceWatchdog
+            run_kw["watchdog"] = DivergenceWatchdog(
+                max_rollbacks=args.max_rollbacks)
+        if faults:
+            from .resilience import FaultInjector
+            run_kw["injector"] = FaultInjector(faults)
+        from .resilience import DivergenceError
+        try:
+            out = exp.run(log_every=args.log_every, logger=logger,
+                          ckpt=ckpt, ckpt_every=args.ckpt_every, **eval_kw,
+                          **run_kw)
+        except DivergenceError as e:
+            # the watchdog's clean give-up: budget exhausted, state rolled
+            # back — a non-zero exit with the reason, not a traceback
+            sys.exit(f"divergence watchdog gave up: {e}")
 
         summary = {k: v for k, v in out.items() if k != "history"}
         if args.report and not args.pbt and cfg.n_pods == 1:

@@ -28,3 +28,37 @@ force_cpu(8)  # raises (with the cause named) if 8 CPU devices can't be had
 from rlgpuschedule_tpu.utils.platform import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (ROADMAP.md runs -m 'not "
+        "slow')")
+    config.addinivalue_line(
+        "markers",
+        "sanitize: run under jax_enable_checks + jax_debug_nans (SURVEY.md "
+        "§5 sanitizer note). Opt-in: debug_nans re-executes every jitted "
+        "program eagerly on a hit and disables some fusions, so only a "
+        "fast smoke subset carries it — and never a test that produces "
+        "NaN on purpose (the resilience fault-injection tests)")
+
+
+@pytest.fixture(autouse=True)
+def _sanitize(request):
+    """Enable the JAX sanitizers for tests marked ``sanitize``."""
+    if request.node.get_closest_marker("sanitize") is None:
+        yield
+        return
+    import jax
+    prev_checks = jax.config.jax_enable_checks
+    prev_nans = jax.config.jax_debug_nans
+    jax.config.update("jax_enable_checks", True)
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_checks", prev_checks)
+        jax.config.update("jax_debug_nans", prev_nans)

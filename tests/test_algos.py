@@ -113,6 +113,12 @@ def tiny_env(n_envs=4, short=10.0, long=100.0):
 
 
 class TestTrainStep:
+    # the SURVEY.md §5 sanitizer subset: these two smoke tests run under
+    # jax_enable_checks + jax_debug_nans (conftest's opt-in marker) so
+    # every release of the suite proves one full rollout+update of each
+    # algorithm is NaN-clean under the strict interpreter, not just
+    # finite in its reduced metrics
+    @pytest.mark.sanitize
     def test_ppo_step_runs_and_is_finite(self):
         env_params, traces = tiny_env()
         net = make_policy("flat", env_params.n_actions)
@@ -130,6 +136,7 @@ class TestTrainStep:
         for v in metrics:
             assert np.isfinite(float(v)), metrics
 
+    @pytest.mark.sanitize
     def test_a2c_step_runs_and_is_finite(self):
         env_params, traces = tiny_env()
         net = make_policy("flat", env_params.n_actions)

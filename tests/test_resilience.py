@@ -1,0 +1,357 @@
+"""Fault-tolerance tests (ISSUE 1 / SURVEY.md §5 "Failure detection /
+elastic recovery / fault injection"): every recovery path is driven by the
+deterministic fault-injection harness on CPU —
+
+- injected NaN gradient -> divergence watchdog rolls back to the last
+  good checkpoint exactly once and the run completes finite;
+- corrupted latest checkpoint -> ``Checkpointer.restore`` falls back to
+  the previous retained step (and raises ``CheckpointRestoreError`` only
+  when EVERY retained step is corrupt);
+- a dead PBT member (non-finite fitness) -> exploit re-seeds it from the
+  best finite member instead of letting NaN win the tournament.
+
+The killed-multihost-rank path lives in ``test_multihost.py`` (it spawns
+real processes); this file covers everything in-process.
+"""
+import dataclasses
+import math
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rlgpuschedule_tpu import train as train_cli
+from rlgpuschedule_tpu.algos import PPOConfig
+from rlgpuschedule_tpu.checkpoint import Checkpointer, CheckpointRestoreError
+from rlgpuschedule_tpu.configs import CONFIGS
+from rlgpuschedule_tpu.experiment import Experiment, PopulationExperiment
+from rlgpuschedule_tpu.parallel import (HParams, PBTConfig, exploit_explore)
+from rlgpuschedule_tpu.resilience import (DivergenceError,
+                                          DivergenceWatchdog, FaultInjector,
+                                          HeartbeatMonitor, HeartbeatWriter,
+                                          corrupt_checkpoint, parse_fault)
+
+# same shapes as test_checkpoint's resume tests so the persistent XLA
+# cache already holds every program this file compiles
+SMALL = dataclasses.replace(
+    CONFIGS["ppo-mlp-synth64"], n_envs=2, window_jobs=16, horizon=64,
+    ppo=PPOConfig(n_steps=8, n_epochs=1, n_minibatches=2))
+
+# matches tests/test_cli.py FAST (again: compile-cache reuse)
+CLI_FAST = ["--iterations", "4", "--n-envs", "4", "--n-nodes", "2",
+            "--gpus-per-node", "4", "--window-jobs", "16",
+            "--log-every", "1", "--horizon", "64", "--queue-len", "4",
+            "--n-steps", "8", "--n-epochs", "1", "--n-minibatches", "2"]
+
+
+class TestParseFault:
+    def test_parses_kind_at_rank(self):
+        s = parse_fault("nan-grad@3")
+        assert (s.kind, s.at, s.rank, s.fired) == ("nan-grad", 3, 0, False)
+        s = parse_fault("kill-rank@2:rank=1")
+        assert (s.kind, s.at, s.rank) == ("kill-rank", 2, 1)
+        s = parse_fault("corrupt-ckpt@7")
+        assert (s.kind, s.at) == ("corrupt-ckpt", 7)
+
+    @pytest.mark.parametrize("bad", ["nan@3", "nan-grad", "nan-grad@x",
+                                     "nan-grad@3:bogus=2", "@2", ""])
+    def test_bad_specs_raise_with_the_spec_named(self, bad):
+        with pytest.raises(ValueError, match="fault"):
+            parse_fault(bad)
+
+
+class TestWatchdogChecks:
+    def test_finite_metrics_pass(self):
+        wd = DivergenceWatchdog()
+        assert wd.check({"total_loss": 0.5, "mean_reward": -1.0}) is None
+
+    def test_non_finite_metric_flagged(self):
+        wd = DivergenceWatchdog()
+        assert "nan" in wd.check({"total_loss": float("nan")}).lower()
+        assert wd.check({"mean_reward": float("inf")}) is not None
+
+    def test_loss_blowup_flagged_against_ema(self):
+        wd = DivergenceWatchdog(blowup_factor=100.0)
+        for _ in range(5):
+            assert wd.check({"total_loss": 1.0}) is None
+        reason = wd.check({"total_loss": 1e6})
+        assert reason is not None and "blow-up" in reason
+
+    def test_first_iteration_large_loss_is_not_a_blowup(self):
+        # no EMA yet -> nothing to blow up against
+        wd = DivergenceWatchdog(blowup_factor=100.0)
+        assert wd.check({"total_loss": 1e9}) is None
+
+    def test_population_single_dead_member_is_pbts_job(self):
+        wd = DivergenceWatchdog()
+        assert wd.check_population([float("nan"), 1.0]) is None
+        reason = wd.check_population([float("nan"), float("inf")])
+        assert reason is not None and "non-finite" in reason
+
+    def test_zero_budget_raises_cleanly(self):
+        wd = DivergenceWatchdog(max_rollbacks=0)
+        with pytest.raises(DivergenceError, match="max_rollbacks"):
+            wd.rollback(None, None, 3, "non-finite total_loss")
+
+
+class TestHeartbeat:
+    def test_beat_read_roundtrip(self, tmp_path):
+        hb = HeartbeatWriter(str(tmp_path), rank=1)
+        hb.beat(4)
+        mon = HeartbeatMonitor(str(tmp_path), n_ranks=2, timeout_s=60.0)
+        beats = mon.read()
+        assert beats[1][0] == 4
+        # rank 0 never wrote but is inside the startup grace window
+        assert mon.stale_ranks() == []
+
+    def test_stale_rank_detected_and_restart_rearms(self, tmp_path):
+        hb = HeartbeatWriter(str(tmp_path), rank=0)
+        hb.beat(0)
+        mon = HeartbeatMonitor(str(tmp_path), n_ranks=2, timeout_s=0.05)
+        time.sleep(0.1)
+        # rank 0's file is stale; rank 1 never appeared past its grace
+        assert mon.stale_ranks() == [0, 1]
+        mon.restart()
+        assert 0 in mon.stale_ranks() and 1 not in mon.stale_ranks()
+        hb.beat(1)
+        assert 0 not in mon.stale_ranks()
+
+
+class TestNaNGradRollback:
+    def test_injected_nan_triggers_one_rollback_and_run_completes(
+            self, tmp_path, capsys):
+        """Acceptance path 1: nan-grad@2 poisons params+metrics; the
+        watchdog rolls back to the iteration-1 checkpoint, the retry (LR
+        halved, RNG folded) converges on, and the summary records exactly
+        one rollback with the recovery visible in the run log."""
+        exp = Experiment.build(SMALL)
+        wd = DivergenceWatchdog(max_rollbacks=3)
+        inj = FaultInjector([parse_fault("nan-grad@2")])
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            out = exp.run(iterations=4, log_every=1, ckpt=ck,
+                          ckpt_every=1, watchdog=wd, injector=inj)
+        assert out["rollbacks"] == 1
+        ev = out["rollback_events"][0]
+        assert ev["iteration"] == 2
+        assert ev["resume_iteration"] == 2
+        assert ev["lr_scale"] == 0.5
+        assert ev["restored_step"] is not None
+        assert "non-finite" in ev["reason"]
+        # the run converged on: final params and every logged row finite
+        assert all(np.isfinite(v)
+                   for v in jax.tree.leaves(
+                       jax.tree.map(lambda x: float(jnp.sum(x)),
+                                    exp.train_state.params)))
+        final_rows = [h for h in out["history"] if h["iteration"] == 3]
+        assert final_rows and all(
+            math.isfinite(v) for h in final_rows for v in h.values())
+        err = capsys.readouterr().err
+        assert "fault-injection: nan-grad at iteration 2" in err
+        assert "watchdog:" in err and "rolled back" in err
+
+    def test_without_watchdog_the_fault_really_poisons(self):
+        # the control: same fault, no watchdog -> params end non-finite
+        # (proves the recovery test above is recovering from a real fault)
+        exp = Experiment.build(SMALL)
+        inj = FaultInjector([parse_fault("nan-grad@1")])
+        exp.run(iterations=2, injector=inj)
+        total = sum(float(jnp.sum(x))
+                    for x in jax.tree.leaves(exp.train_state.params))
+        assert not math.isfinite(total)
+
+    def test_exhausted_budget_raises_divergence_error(self, tmp_path):
+        # two distinct faults, budget of one: the second rollback attempt
+        # must give up cleanly
+        exp = Experiment.build(SMALL)
+        wd = DivergenceWatchdog(max_rollbacks=1)
+        inj = FaultInjector([parse_fault("nan-grad@1"),
+                             parse_fault("nan-grad@2")])
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            with pytest.raises(DivergenceError, match="giving up"):
+                exp.run(iterations=4, ckpt=ck, ckpt_every=1,
+                        watchdog=wd, injector=inj)
+
+    def test_watchdog_requires_checkpointer(self):
+        exp = Experiment.build(SMALL)
+        with pytest.raises(ValueError, match="ckpt"):
+            exp.run(iterations=1, watchdog=DivergenceWatchdog())
+
+
+class TestCorruptCheckpointFallback:
+    def _two_step_store(self, tmp_path):
+        exp = Experiment.build(SMALL)
+        ck = Checkpointer(str(tmp_path / "ck"), max_to_keep=3)
+        exp.run(iterations=2, ckpt=ck, ckpt_every=1)
+        ck.wait()
+        assert len(ck.all_steps()) >= 2
+        return exp, ck
+
+    def test_corrupt_latest_falls_back_to_previous(self, tmp_path, capsys):
+        """Acceptance path 2: the latest step's data files are truncated;
+        restore lands on the previous retained step instead of raising,
+        and says so in the log."""
+        exp, ck = self._two_step_store(tmp_path)
+        steps = ck.all_steps()
+        n = corrupt_checkpoint(ck.directory, steps[-1])
+        assert n > 0
+        exp2 = Experiment.build(SMALL)
+        exp2.restore_checkpoint(ck)
+        assert ck.last_restored_step == steps[-2]
+        total = sum(float(jnp.sum(x))
+                    for x in jax.tree.leaves(exp2.train_state.params))
+        assert math.isfinite(total)
+        err = capsys.readouterr().err
+        assert "falling back to step" in err
+        ck.close()
+
+    def test_all_steps_corrupt_raises_restore_error(self, tmp_path):
+        exp, ck = self._two_step_store(tmp_path)
+        for s in ck.all_steps():
+            corrupt_checkpoint(ck.directory, s)
+        with pytest.raises(CheckpointRestoreError, match="failed to"):
+            Experiment.build(SMALL).restore_checkpoint(ck)
+        ck.close()
+
+    def test_explicit_step_does_not_fall_back(self, tmp_path):
+        exp, ck = self._two_step_store(tmp_path)
+        bad = ck.all_steps()[-1]
+        corrupt_checkpoint(ck.directory, bad)
+        with pytest.raises(Exception) as ei:
+            Experiment.build(SMALL).restore_checkpoint(ck, step=bad)
+        assert not isinstance(ei.value, CheckpointRestoreError)
+        ck.close()
+
+    def test_corrupt_missing_step_dir_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            corrupt_checkpoint(str(tmp_path), 123)
+
+
+class TestPBTDeadMembers:
+    def _hp(self, n):
+        return HParams(lr=jnp.full((n,), 3e-4),
+                       ent_coef=jnp.full((n,), 0.01),
+                       clip_eps=jnp.full((n,), 0.2))
+
+    def test_dead_members_reseed_from_best_even_past_quota(self):
+        # 2 of 4 dead with exploit_frac=0.25 (quota 1): the old NaN-ranks-
+        # worst rule would leave one dead member alive; now both re-seed
+        # from the best finite member
+        rng = np.random.default_rng(0)
+        fitness = np.array([np.nan, 1.0, 2.0, np.inf])
+        d = exploit_explore(rng, fitness, self._hp(4),
+                            PBTConfig(exploit_frac=0.25))
+        assert d.src[0] == 2 and d.src[3] == 2
+        assert d.exploited[0] and d.exploited[3]
+
+    def test_winners_never_drawn_from_dead_members(self):
+        # divergence reaching the top quantile: member 3 (NaN) sits where
+        # argsort-with-NaN-last used to place a winner
+        rng = np.random.default_rng(1)
+        fitness = np.array([0.0, 1.0, 2.0, np.nan])
+        for _ in range(10):
+            d = exploit_explore(rng, fitness, self._hp(4),
+                                PBTConfig(exploit_frac=0.25))
+            assert d.src[0] != 3 and d.src[3] == 2
+
+    def test_no_finite_member_means_nobody_copies(self):
+        # nobody to re-seed from: keep states (whole-run rollback is the
+        # population watchdog's job, not exploit's)
+        rng = np.random.default_rng(2)
+        fitness = np.full((4,), np.nan)
+        d = exploit_explore(rng, fitness, self._hp(4), PBTConfig())
+        assert not d.exploited.any()
+
+    def test_population_run_recovers_injected_member_nan(self, tmp_path):
+        """Acceptance path 1 (population flavor): member 1 is poisoned at
+        iteration 1; the next exploit round re-seeds it from the best
+        member and the run ends with every member finite."""
+        cfg = dataclasses.replace(SMALL, n_envs=4)
+        exp = PopulationExperiment.build(
+            cfg, n_pop=2, mesh=None,
+            pbt_cfg=PBTConfig(ready_iters=1, seed=0))
+        inj = FaultInjector([parse_fault("nan-grad@1:rank=1")])
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            out = exp.run(iterations=4, log_every=1, ckpt=ck,
+                          ckpt_every=2, injector=inj,
+                          watchdog=DivergenceWatchdog(max_rollbacks=1))
+        assert all(np.isfinite(out["final_fitness"])), out["final_fitness"]
+        # the catastrophic-case watchdog never had to fire: one dead
+        # member is exploit's job
+        assert out["rollbacks"] == 0
+        total = sum(float(jnp.sum(x))
+                    for x in jax.tree.leaves(exp.states.params))
+        assert math.isfinite(total)
+
+
+class TestResilienceCLI:
+    def test_nan_grad_rollback_end_to_end(self, tmp_path, capsys):
+        summary = train_cli.main(
+            ["--config", "ppo-mlp-synth64", *CLI_FAST,
+             "--ckpt-dir", str(tmp_path / "ck"), "--ckpt-every", "1",
+             "--fault", "nan-grad@2", "--max-rollbacks", "2"])
+        assert summary["rollbacks"] == 1
+        assert summary["rollback_events"][0]["iteration"] == 2
+        assert np.isfinite(summary["env_steps_per_sec"])
+        err = capsys.readouterr().err
+        assert "fault-injection" in err and "watchdog" in err
+
+    def test_corrupt_ckpt_fault_then_resume_falls_back(self, tmp_path,
+                                                       capsys):
+        """Acceptance path 2, end to end: the checkpoint saved at
+        iteration 3 (the latest) is truncated by the injected fault; the
+        resumed run restores the iteration-2 step instead and completes."""
+        args = ["--config", "ppo-mlp-synth64", *CLI_FAST,
+                "--ckpt-dir", str(tmp_path / "ck"), "--ckpt-every", "1"]
+        train_cli.main(args + ["--fault", "corrupt-ckpt@3"])
+        assert "corrupted checkpoint" in capsys.readouterr().err
+        out = train_cli.main(args + ["--resume"])
+        assert out["iterations"] == 4
+        assert np.isfinite(out["env_steps_per_sec"])
+        assert "falling back to step" in capsys.readouterr().err
+
+    def test_kill_rank_refused_by_single_process_cli(self):
+        with pytest.raises(SystemExit, match="multihost"):
+            train_cli.main(["--config", "ppo-mlp-synth64", *CLI_FAST,
+                            "--fault", "kill-rank@1:rank=0"])
+
+    def test_bad_fault_spec_exits_with_message(self):
+        with pytest.raises(SystemExit, match="fault"):
+            train_cli.main(["--config", "ppo-mlp-synth64", *CLI_FAST,
+                            "--fault", "nonsense"])
+
+    def test_max_rollbacks_requires_ckpt_dir(self):
+        with pytest.raises(SystemExit, match="ckpt-dir"):
+            train_cli.main(["--config", "ppo-mlp-synth64", *CLI_FAST,
+                            "--max-rollbacks", "2"])
+
+    def test_corrupt_ckpt_fault_requires_ckpt_dir(self):
+        with pytest.raises(SystemExit, match="ckpt-dir"):
+            train_cli.main(["--config", "ppo-mlp-synth64", *CLI_FAST,
+                            "--fault", "corrupt-ckpt@1"])
+
+
+class TestSelectCheckpointSeedGuards:
+    def test_val_seed_matching_eval_probe_default_refused(self):
+        from rlgpuschedule_tpu import select_checkpoint
+        # config seed 0 -> the in-training probe's default held-out
+        # stream is seed 1000; selecting on it is not validation
+        with pytest.raises(SystemExit, match="eval-every"):
+            select_checkpoint.main(["--ckpt-dir", "/nonexistent",
+                                    "--val-seed", "1000"])
+
+    def test_test_seed_must_differ_from_val_seed(self):
+        from rlgpuschedule_tpu import select_checkpoint
+        with pytest.raises(SystemExit, match="disjoint"):
+            select_checkpoint.main(["--ckpt-dir", "/nonexistent",
+                                    "--val-seed", "77",
+                                    "--test-seed", "77"])
+
+    def test_test_seed_must_differ_from_training_seed(self):
+        from rlgpuschedule_tpu import select_checkpoint
+        with pytest.raises(SystemExit, match="training seed"):
+            select_checkpoint.main(["--ckpt-dir", "/nonexistent",
+                                    "--val-seed", "77",
+                                    "--test-seed", "0"])
