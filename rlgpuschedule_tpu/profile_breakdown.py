@@ -27,11 +27,28 @@ Usage::
 
     python -m rlgpuschedule_tpu.profile_breakdown [--cpu] [--repeats 5]
         [--trace-dir /tmp/jax-trace] [--n-envs 512] [--n-steps 128]
+        [--n-epochs 2] [--n-minibatches 8 | --minibatch-size N]
+        [--bf16-update]
+    python -m rlgpuschedule_tpu.profile_breakdown [--cpu] \
+        --sweep-minibatch [--sweep-out sweep.json]
 
 Prints one JSON object with per-stage seconds/iteration, the stage shares,
 an env-steps/s figure, and a model-FLOPs/s estimate (policy fwd+bwd FLOPs
 from param count — the MXU utilization proxy; the env scan does almost no
 matmul work, so "MFU" here is meaningful for the update stage only).
+
+``--sweep-minibatch`` is the automated minibatch-geometry lever sweep
+(BASELINE.md named it "the first lever the next TPU session should
+profile"): one rollout+GAE is materialized, then the update stage alone is
+timed at every power-of-two minibatch count that tiles the batch, on
+whatever backend jax picked — the same artifact schema on CPU and TPU
+(``mfu_update`` is null off-chip where no bf16 peak is known). The output
+is a RANKED JSON artifact (fastest geometry first, ``best`` duplicated at
+the top level); feed it to ``bench.py --sweep`` so the headline number
+reflects the lever. The update step is timed exactly as production runs
+it: optimizer/param buffers donated and threaded call-to-call
+(``algos.update.make_update_step``), so no per-call state reallocation
+pollutes the measurement.
 """
 from __future__ import annotations
 
@@ -51,6 +68,80 @@ def _median_time(fn, repeats: int) -> float:
     return statistics.median(samples)
 
 
+# MFU pricing: the chip's bf16 matmul peak (the networks run bf16
+# compute), keyed on device_kind — platform == "tpu" alone would price
+# every generation at the v5e's peak. This is the measured replacement
+# for the "dispatch/HBM-bound" assertion (VERDICT r4 missing #4):
+# mfu_total over the whole fused step, and mfu_update over the update
+# stage alone (the only stage whose matmuls could fill the MXU — the
+# env scan does no matmul work). Public bf16 peaks per chip.
+BF16_PEAK = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
+             "v5p": 459e12, "v5": 459e12, "v6 lite": 918e12,
+             "v6e": 918e12}
+
+
+def _sweep_minibatch(args, ppo, platform, kind, peak, B, n_params,
+                     timed_update, state, tr, adv, ret, key, n) -> dict:
+    """Time the update stage over the geometry grid — epochs in
+    ``{1, configured}`` × every power-of-two minibatch count that tiles
+    the batch (plus the configured default) — and rank the geometries
+    fastest-first. All three axes of the ``n_epochs × n_minibatches ×
+    minibatch_size`` triple are covered (minibatch_size is the derived
+    ``B / n_minibatches``); ``n_epochs`` scales the update's FLOPs
+    linearly, so same-epoch rows compare pure geometry overhead/MXU fill
+    while the 1-epoch rows price the fused single-pass recipe. Same
+    artifact on CPU and TPU; ``mfu_update`` is null where no bf16 peak is
+    known (non-TPU backends)."""
+    import dataclasses as _dc
+
+    from rlgpuschedule_tpu.algos import resolve_geometry
+
+    _, default_mb, _sz = resolve_geometry(ppo.n_epochs, ppo.n_minibatches,
+                                          ppo.minibatch_size, B)
+    mbs = sorted({m for m in (2 ** p for p in range(0, 8))
+                  if m <= B and B % m == 0} | {default_mb})
+    results = []
+    for e in sorted({1, ppo.n_epochs}):
+        upd_evals = e * B                        # fwd+bwd per sample
+        upd_flops = 2 * n_params * 3 * upd_evals
+        for m in mbs:
+            geom = _dc.replace(ppo, n_epochs=e, n_minibatches=m,
+                               minibatch_size=None)
+            t = timed_update(geom, state, tr, adv, ret, key, n)
+            results.append({
+                "n_epochs": e, "n_minibatches": m,
+                "minibatch_size": B // m,
+                "update_s_per_iteration": round(t, 5),
+                "update_env_steps_per_sec": round(B / t, 1),
+                "model_flops_per_sec": round(upd_flops / t, 1),
+                "mfu_update": round(upd_flops / t / peak, 6)
+                if peak is not None else None,
+            })
+    default = next(r for r in results
+                   if r["n_epochs"] == ppo.n_epochs
+                   and r["n_minibatches"] == default_mb)
+    t_default = default["update_s_per_iteration"]
+    for r in results:
+        r["speedup_vs_default"] = round(
+            t_default / r["update_s_per_iteration"], 3)
+    results.sort(key=lambda r: r["update_s_per_iteration"])
+    out = {
+        "sweep": "minibatch-geometry",
+        "platform": platform,
+        "device_kind": kind or None,
+        "n_envs": tr.reward.shape[1], "n_steps": ppo.n_steps,
+        "batch_per_iteration": B,
+        "bf16_update": ppo.bf16_update,
+        "policy_params": int(n_params),
+        "assumed_bf16_peak_flops": peak,
+        "default_geometry": {"n_epochs": ppo.n_epochs,
+                             "n_minibatches": default_mb},
+        "results": results,            # ranked fastest-first
+        "best": results[0],
+    }
+    return out
+
+
 def main(argv: list[str] | None = None) -> dict:
     ap = argparse.ArgumentParser(prog="rlgpuschedule_tpu.profile_breakdown")
     ap.add_argument("--cpu", action="store_true",
@@ -61,10 +152,33 @@ def main(argv: list[str] | None = None) -> dict:
                     help="default: 512 on TPU, 32 on CPU")
     ap.add_argument("--n-steps", type=int, default=None,
                     help="default: 128 on TPU, 64 on CPU")
+    ap.add_argument("--n-epochs", type=int, default=2,
+                    help="update geometry: PPO epochs over the batch")
+    ap.add_argument("--n-minibatches", type=int, default=8,
+                    help="update geometry: minibatch count per epoch "
+                         "(profile the swept-best with e.g. 1)")
+    ap.add_argument("--minibatch-size", type=int, default=None,
+                    help="update geometry: explicit minibatch size; "
+                         "overrides --n-minibatches (algos.update "
+                         "resolve_geometry contract)")
+    ap.add_argument("--bf16-update", action="store_true",
+                    help="profile the bf16-compute / fp32-optimizer "
+                         "update path")
+    ap.add_argument("--sweep-minibatch", action="store_true",
+                    help="time the update stage over a grid of minibatch "
+                         "geometries and emit a ranked JSON artifact "
+                         "(steps/s + mfu_update) instead of the stage "
+                         "breakdown")
+    ap.add_argument("--sweep-out", default=None,
+                    help="with --sweep-minibatch: also write the ranked "
+                         "artifact to this path (bench.py --sweep reads "
+                         "it)")
     ap.add_argument("--trace-dir", default=None,
                     help="also capture a jax.profiler trace of the fused "
                          "loop here")
     args = ap.parse_args(argv)
+    if args.sweep_out and not args.sweep_minibatch:
+        ap.error("--sweep-out only applies with --sweep-minibatch")
 
     if args.cpu:
         from rlgpuschedule_tpu.utils.platform import force_cpu
@@ -76,10 +190,11 @@ def main(argv: list[str] | None = None) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from rlgpuschedule_tpu.algos import PPOConfig
+    from rlgpuschedule_tpu.algos import PPOConfig, resolve_geometry
     from rlgpuschedule_tpu.algos.ppo import (normalize_advantages,
                                              run_ppo_epochs)
     from rlgpuschedule_tpu.algos.rollout import rollout
+    from rlgpuschedule_tpu.algos.update import make_update_step
     from rlgpuschedule_tpu.configs import PPO_MLP_SYNTH64
     from rlgpuschedule_tpu.experiment import Experiment
     from rlgpuschedule_tpu.ops.gae import compute_gae
@@ -89,14 +204,25 @@ def main(argv: list[str] | None = None) -> dict:
     on_cpu = platform == "cpu"
     n_envs = args.n_envs or (32 if on_cpu else 512)
     n_steps = args.n_steps or (64 if on_cpu else 128)
-    ppo = PPOConfig(n_steps=n_steps, n_epochs=2, n_minibatches=8)
+    ppo = PPOConfig(n_steps=n_steps, n_epochs=args.n_epochs,
+                    n_minibatches=args.n_minibatches,
+                    minibatch_size=args.minibatch_size,
+                    bf16_update=args.bf16_update)
     cfg = dataclasses.replace(PPO_MLP_SYNTH64, n_envs=n_envs, ppo=ppo)
     exp = Experiment.build(cfg)
     env_params, apply_fn = exp.env_params, exp.apply_fn
     state, carry, traces = exp.train_state, exp.carry, exp.traces
     key = jax.random.PRNGKey(0)
+    B = n_steps * n_envs
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    peak = next((v for k, v in BF16_PEAK.items()
+                 if f"tpu {k}" in kind or kind == k), None) \
+        if platform == "tpu" else None
 
-    # ---- stage jits (no donation: inputs are reused across repeats) ------
+    # ---- stage jits (batch inputs are reused across repeats, so only the
+    # update's state — the buffers production donates — is donated and
+    # threaded call-to-call) ----------------------------------------------
     @jax.jit
     def rollout_only(params, carry):
         return rollout(apply_fn, params, env_params, traces, carry, n_steps)
@@ -107,23 +233,45 @@ def main(argv: list[str] | None = None) -> dict:
                                ppo.gamma, ppo.gae_lambda)
         return normalize_advantages(adv), ret
 
-    @jax.jit
-    def update_only(state, tr, adv, ret, key):
-        return run_ppo_epochs(
-            apply_fn, ppo, state, tr, adv, ret, key,
-            lambda s, g: s.apply_gradients(grads=g))
+    def _timed_update(ppo_g, state0, tr, adv, ret, key, n):
+        """Median seconds/iteration of the donated update step at geometry
+        ``ppo_g``, threading the donated state like the production loop."""
+        upd = make_update_step(
+            lambda s, t, a, r, k: run_ppo_epochs(
+                apply_fn, ppo_g, s, t, a, r, k,
+                lambda st, g: st.apply_gradients(grads=g)))
+        cell = {"s": jax.jit(lambda t: jax.tree.map(jnp.copy, t))(state0)}
+        cell["s"], _ = jax.block_until_ready(
+            upd(cell["s"], tr, adv, ret, key))         # compile + warm
+
+        def run_n():
+            for _ in range(n):
+                cell["s"], _m = upd(cell["s"], tr, adv, ret, key)
+            jax.block_until_ready(cell["s"].params)
+
+        return _median_time(run_n, args.repeats) / n
 
     _, tr, last_value = jax.block_until_ready(
         rollout_only(state.params, carry))
     adv, ret = jax.block_until_ready(gae_only(tr, last_value))
-    jax.block_until_ready(update_only(state, tr, adv, ret, key))
+
+    n = args.iters_per_repeat
+    if args.sweep_minibatch:
+        out = _sweep_minibatch(args, ppo, platform, kind, peak, B, n_params,
+                               _timed_update, state, tr, adv, ret, key, n)
+        print(json.dumps(out))
+        if args.sweep_out:
+            with open(args.sweep_out, "w") as f:
+                json.dump(out, f, indent=1)
+        return out
+
+    t_upd = _timed_update(ppo, state, tr, adv, ret, key, n)
 
     fused = exp.train_step     # the production jit (donates; returns fresh)
     state2, carry2, _ = fused(state, carry, traces, key)
     jax.block_until_ready(state2.params)
     state, carry = state2, carry2   # donated originals are dead now
 
-    n = args.iters_per_repeat
     t_roll = _median_time(
         lambda: jax.block_until_ready(
             [rollout_only(state.params, carry) for _ in range(n)]),
@@ -131,10 +279,6 @@ def main(argv: list[str] | None = None) -> dict:
     t_gae = _median_time(
         lambda: jax.block_until_ready(
             [gae_only(tr, last_value) for _ in range(n)]),
-        args.repeats) / n
-    t_upd = _median_time(
-        lambda: jax.block_until_ready(
-            [update_only(state, tr, adv, ret, key) for _ in range(n)]),
         args.repeats) / n
 
     def fused_loop(block_every: bool = False):
@@ -158,29 +302,18 @@ def main(argv: list[str] | None = None) -> dict:
 
     # model-FLOPs proxy: 2*params per fwd MAC, 3x for fwd+bwd, over every
     # policy evaluation (T rollout steps + 1 bootstrap + epochs*B updates)
-    n_params = sum(x.size for x in jax.tree.leaves(state.params))
-    B = n_steps * n_envs
     fwd_evals = B + n_envs                      # rollout + bootstrap value
     upd_evals = ppo.n_epochs * B                # fwd+bwd per sample
     flops = 2 * n_params * (fwd_evals + 3 * upd_evals)
-    # MFU vs the chip's bf16 matmul peak (the networks run bf16 compute),
-    # keyed on device_kind — platform == "tpu" alone would price every
-    # generation at the v5e's peak. This is the measured replacement for
-    # the "dispatch/HBM-bound" assertion (VERDICT r4 missing #4):
-    # mfu_total over the whole fused step, and mfu_update over the update
-    # stage alone (the only stage whose matmuls could fill the MXU — the
-    # env scan does no matmul work). Public bf16 peaks per chip.
-    BF16_PEAK = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
-                 "v5p": 459e12, "v5": 459e12, "v6 lite": 918e12,
-                 "v6e": 918e12}
-    kind = getattr(jax.devices()[0], "device_kind", "").lower()
-    peak = next((v for k, v in BF16_PEAK.items()
-                 if f"tpu {k}" in kind or kind == k), None) \
-        if platform == "tpu" else None
     upd_flops = 2 * n_params * 3 * upd_evals
+    _, n_mb, mb = resolve_geometry(ppo.n_epochs, ppo.n_minibatches,
+                                   ppo.minibatch_size, B)
     out = {
         "platform": platform,
         "n_envs": n_envs, "n_steps": n_steps,
+        "geometry": {"n_epochs": ppo.n_epochs, "n_minibatches": n_mb,
+                     "minibatch_size": mb,
+                     "bf16_update": ppo.bf16_update},
         "seconds_per_iteration": {
             "rollout": round(t_roll, 5), "gae": round(t_gae, 5),
             "update": round(t_upd, 5), "fused_loop": round(t_loop, 5),

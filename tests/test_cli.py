@@ -186,10 +186,32 @@ class TestTrainCLI:
             ["--config", "a2c-pai-fair", "--lr", "1e-3", "--n-steps", "8"])
         cfg = train_cli.apply_overrides(CONFIGS["a2c-pai-fair"], a2c_args)
         assert (cfg.a2c.lr, cfg.a2c.n_steps) == (1e-3, 8)
-        bad = train_cli.build_parser().parse_args(
-            ["--config", "a2c-pai-fair", "--n-epochs", "2"])
-        with pytest.raises(SystemExit):
-            train_cli.apply_overrides(CONFIGS["a2c-pai-fair"], bad)
+        # A2C runs the shared minibatch-geometry engine too (its preset
+        # 1x1 geometry is the classic full-batch update), so geometry
+        # overrides now land in cfg.a2c instead of being refused
+        a2c_geom = train_cli.build_parser().parse_args(
+            ["--config", "a2c-pai-fair", "--n-epochs", "2",
+             "--n-minibatches", "4"])
+        cfg = train_cli.apply_overrides(CONFIGS["a2c-pai-fair"], a2c_geom)
+        assert (cfg.a2c.n_epochs, cfg.a2c.n_minibatches) == (2, 4)
+
+    def test_minibatch_geometry_and_bf16_overrides(self):
+        # the ISSUE-2 lever flags: --minibatch-size (overrides
+        # --n-minibatches, algos.update contract) and --bf16-update
+        from rlgpuschedule_tpu.configs import CONFIGS
+        args = train_cli.build_parser().parse_args(
+            ["--config", "ppo-mlp-synth64", "--minibatch-size", "64",
+             "--bf16-update"])
+        cfg = train_cli.apply_overrides(CONFIGS["ppo-mlp-synth64"], args)
+        assert cfg.ppo.minibatch_size == 64
+        assert cfg.ppo.bf16_update is True
+        # untouched flags keep preset values
+        assert cfg.ppo.n_epochs == 4 and cfg.ppo.bf16_update is True
+        base = train_cli.build_parser().parse_args(
+            ["--config", "ppo-mlp-synth64"])
+        cfg = train_cli.apply_overrides(CONFIGS["ppo-mlp-synth64"], base)
+        assert cfg.ppo.minibatch_size is None
+        assert cfg.ppo.bf16_update is False
 
     def test_obs_kind_override(self):
         # --obs-kind swaps the preset's encoder family (e.g. config 2's
@@ -349,4 +371,140 @@ class TestEvaluateCLI:
              "--n-nodes", "4", "--gpus-per-node", "4", "--window-jobs", "16",
              "--horizon", "48", "--max-steps", "48"])
         assert "policy" in report and "tiresias" in report
+        assert np.isfinite(report["policy"])
+
+
+class TestMinibatchSweep:
+    """profile_breakdown --sweep-minibatch: the automated geometry lever
+    sweep must emit a ranked artifact that bench.py can consume."""
+
+    def test_sweep_artifact_ranked_and_written(self, tmp_path, capsys):
+        from rlgpuschedule_tpu import profile_breakdown
+        out_path = str(tmp_path / "sweep.json")
+        art = profile_breakdown.main(
+            ["--n-envs", "2", "--n-steps", "8", "--repeats", "1",
+             "--iters-per-repeat", "1", "--sweep-minibatch",
+             "--sweep-out", out_path])
+        capsys.readouterr()
+        assert art["sweep"] == "minibatch-geometry"
+        assert art["batch_per_iteration"] == 16
+        times = [r["update_s_per_iteration"] for r in art["results"]]
+        assert times == sorted(times), "results must rank fastest-first"
+        assert art["best"] == art["results"][0]
+        # grid covers the epochs axis and every tiling minibatch count
+        geoms = {(r["n_epochs"], r["n_minibatches"])
+                 for r in art["results"]}
+        assert {(1, 1), (1, 16), (2, 8)} <= geoms
+        for r in art["results"]:
+            assert r["minibatch_size"] * r["n_minibatches"] == 16
+            assert r["update_env_steps_per_sec"] > 0
+            assert "mfu_update" in r          # null off-TPU, present always
+            assert r["speedup_vs_default"] > 0
+        default = next(r for r in art["results"]
+                       if (r["n_epochs"], r["n_minibatches"]) == (2, 8))
+        assert default["speedup_vs_default"] == pytest.approx(1.0)
+        # the artifact on disk is the same object bench.py --sweep reads
+        with open(out_path) as f:
+            on_disk = json.load(f)
+        assert on_disk["best"] == art["best"]
+        import bench
+        e, m = bench.geometry_from_sweep(out_path)
+        assert (e, m) == (art["best"]["n_epochs"],
+                          art["best"]["n_minibatches"])
+
+    def test_bench_refuses_non_sweep_artifact(self, tmp_path):
+        import bench
+        bad = tmp_path / "not_a_sweep.json"
+        bad.write_text(json.dumps({"metric": "x"}))
+        with pytest.raises(SystemExit):
+            bench.geometry_from_sweep(str(bad))
+
+
+class TestStallGuardEngage:
+    def test_guard_engage_path_decides_completion_from_cli(self, tmp_path):
+        """ISSUE-2 satellite (VERDICT r5 weak #4): a REAL place<->preempt
+        deadlock driven from the evaluate CLI — guard-off must read <100%
+        completion (the completion guard flags it), guard-on must
+        complete. The cycler is the synthetic form of the measured
+        config-1p staller (BASELINE.md 'Learned preemption'): a constant-
+        logit policy that prefers preempting the most-attained running
+        job over placing, so greedy replay ping-pongs place<->preempt at
+        clock 0.0 forever."""
+        import dataclasses
+        import flax
+        import jax.numpy as jnp
+        from rlgpuschedule_tpu.checkpoint import Checkpointer
+        from rlgpuschedule_tpu.configs import CONFIGS
+        from rlgpuschedule_tpu.experiment import Experiment
+
+        over = dict(n_nodes=2, gpus_per_node=4, n_envs=2, window_jobs=16,
+                    queue_len=4, horizon=1024, drain_frac=1.0)
+        cfg = dataclasses.replace(CONFIGS["ppo-mlp-preempt"], **over)
+        exp = Experiment.build(cfg)
+        sim = exp.env_params.sim
+        K, P = sim.queue_len, sim.n_placements
+        flat = flax.traverse_util.flatten_dict(exp.train_state.params)
+        bias = np.zeros(sim.n_actions, np.float32)
+        bias[:K * P] = 1.0       # placements: preferred over no-op
+        bias[K * P] = 2.0        # preempt slot 0: preferred over all
+        bias[-1] = -1.0          # no-op: last resort (advances time)
+        flat[("params", "policy", "kernel")] = jnp.zeros_like(
+            flat[("params", "policy", "kernel")])
+        flat[("params", "policy", "bias")] = jnp.asarray(bias)
+        exp.train_state = exp.train_state.replace(
+            params=flax.traverse_util.unflatten_dict(flat))
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            exp.save_checkpoint(ck)
+
+        common = ["--config", "ppo-mlp-preempt", "--n-nodes", "2",
+                  "--gpus-per-node", "4", "--n-envs", "2",
+                  "--window-jobs", "16", "--queue-len", "4",
+                  "--horizon", "1024", "--drain-frac", "1.0",
+                  "--ckpt-dir", str(tmp_path / "ck"), "--no-random"]
+        raw = evaluate_cli.main(common + ["--no-stall-guard"])
+        assert raw["stall_guard"] is False
+        assert raw["policy_completion"] < 1.0   # deadlocked, flagged
+        guarded = evaluate_cli.main(common)
+        assert guarded["stall_guard"] is True
+        assert guarded["policy_completion"] == 1.0
+        assert np.isfinite(guarded["policy"])
+
+
+class TestPBTKeepBest:
+    def test_pbt_eval_probe_and_best_population_retention(self, tmp_path):
+        """ISSUE-2 satellite (VERDICT r5 weak #2): the PBT path honors
+        --ckpt-keep (series rotation) and retains a probe-selected best/
+        population on the eval cadence."""
+        ck = str(tmp_path / "ck")
+        summary = train_cli.main(
+            ["--config", "ppo-mlp-synth64", "--pbt", "--n-pop", "2",
+             "--pbt-ready", "1", "--iterations", "2", "--n-envs", "4",
+             "--n-nodes", "2", "--gpus-per-node", "4",
+             "--window-jobs", "16", "--horizon", "64", "--queue-len", "4",
+             "--n-steps", "8", "--n-epochs", "1", "--n-minibatches", "2",
+             "--log-every", "1", "--eval-every", "1", "--eval-windows",
+             "2", "--keep-best", "--ckpt-dir", ck, "--ckpt-every", "1",
+             "--ckpt-keep", "1"])
+        assert summary["pbt_events"] >= 1
+        # the probe ran on the eval cadence and its rows are in the summary
+        assert [row["iteration"] for row in summary["eval_history"]] \
+            == [0, 1]
+        assert all("eval_avg_jct" in row for row in summary["eval_history"])
+        from rlgpuschedule_tpu.checkpoint import Checkpointer
+        # --ckpt-keep 1 honored in the PBT path: one retained series step
+        with Checkpointer(ck) as series:
+            assert len(series.all_steps()) == 1
+        # best/ holds a full population checkpoint + the probe bar in meta
+        with Checkpointer(os.path.join(ck, "best")) as best:
+            steps = best.all_steps()
+            assert len(steps) == 1
+            meta = best.read_meta()
+            assert "eval_avg_jct" in meta
+        # and it restores as a population (evaluate --pbt's path)
+        report = evaluate_cli.main(
+            ["--config", "ppo-mlp-synth64", "--pbt", "--n-pop", "2",
+             "--n-envs", "4", "--n-nodes", "2", "--gpus-per-node", "4",
+             "--window-jobs", "16", "--horizon", "64", "--queue-len", "4",
+             "--ckpt-dir", os.path.join(ck, "best"), "--no-random",
+             "--max-steps", "32"])
         assert np.isfinite(report["policy"])
