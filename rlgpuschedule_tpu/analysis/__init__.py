@@ -1,0 +1,44 @@
+"""`jsan` — JAX-pitfall static analysis + runtime performance sentinels.
+
+The north star runs as fast as the hardware allows, and in JAX that speed
+is lost *silently*: a stray ``.item()`` host sync in a hot loop, a dropped
+``donate_argnums`` at a state-threading jit boundary, or a recompile per
+step can erase a measured bench win without failing a single test
+(Podracer arXiv:2104.06272 and Jumanji arXiv:2306.09884 both attribute
+their throughput to exactly this jit/device-residency discipline). The
+``sanitize`` test marker catches NaNs; this package catches
+performance-correctness regressions:
+
+- **Static pass** (``python -m rlgpuschedule_tpu.analysis [paths]``):
+  AST rules grounded in this codebase's real hazards — see
+  :mod:`.rules` for the rule set and :mod:`.engine` for the
+  traced-region model, ``# jsan: disable=<rule>`` suppressions, and the
+  committed-baseline workflow for grandfathered findings.
+- **Runtime sentinels** (:mod:`.sentinels`): a compile-count monitor
+  built on ``jax.monitoring`` (asserts the fused update step compiles
+  exactly once across geometry-stable iterations) and a
+  ``jax.transfer_guard`` context for the perf/sanitize test paths.
+"""
+from .engine import (Finding, SourceFile, analyze_paths, apply_baseline,
+                     load_baseline, make_baseline)
+from .rules import all_rules
+
+__all__ = [
+    "Finding", "SourceFile", "analyze_paths", "all_rules",
+    "load_baseline", "make_baseline", "apply_baseline",
+    "CompileCounter", "RecompileSentinelError", "assert_no_recompiles",
+    "no_implicit_transfers",
+]
+
+_SENTINEL_NAMES = ("CompileCounter", "RecompileSentinelError",
+                   "assert_no_recompiles", "no_implicit_transfers")
+
+
+def __getattr__(name):
+    # lazy (PEP 562): the sentinels import jax; the static pass must not —
+    # `python -m rlgpuschedule_tpu.analysis` is a plain-AST lint and runs
+    # in CI before anything touches an accelerator runtime
+    if name in _SENTINEL_NAMES:
+        from . import sentinels
+        return getattr(sentinels, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,97 @@
+"""jsan CLI: ``python -m rlgpuschedule_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (after suppressions + baseline), 1 findings, 2 bad
+invocation. The default baseline is ``jsan_baseline.json`` in the
+current directory when it exists (the committed grandfather list — see
+README "Static analysis"); ``--no-baseline`` shows everything,
+``--write-baseline`` regenerates the file from the current findings.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .engine import (analyze_paths, apply_baseline, load_baseline,
+                     make_baseline)
+from .rules import all_rules, rule_names
+
+DEFAULT_BASELINE = "jsan_baseline.json"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m rlgpuschedule_tpu.analysis",
+        description="jsan: JAX-pitfall static analyzer (see README "
+                    "'Static analysis' for rules and workflow)")
+    p.add_argument("paths", nargs="*", default=["rlgpuschedule_tpu"],
+                   help="files or directories to analyze (default: "
+                        "rlgpuschedule_tpu)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help=f"baseline JSON of grandfathered findings "
+                        f"(default: {DEFAULT_BASELINE}; silently empty "
+                        f"when the file does not exist)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline file")
+    p.add_argument("--write-baseline", metavar="PATH", default=None,
+                   help="write the current findings as a baseline to "
+                        "PATH and exit 0")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.name}: {rule.summary}")
+        return 0
+
+    try:
+        findings = analyze_paths(args.paths)
+    except FileNotFoundError as e:
+        print(f"jsan: no such path: {e}", file=sys.stderr)
+        return 2
+    except SyntaxError as e:
+        print(f"jsan: cannot parse {e.filename}:{e.lineno}: {e.msg}",
+              file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            json.dump(make_baseline(findings), f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"jsan: wrote {len(findings)} finding(s) to "
+              f"{args.write_baseline}")
+        return 0
+
+    baselined = 0
+    if not args.no_baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except FileNotFoundError:
+            baseline = set()
+        except (json.JSONDecodeError, KeyError, TypeError) as e:
+            print(f"jsan: bad baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        kept = apply_baseline(findings, baseline)
+        baselined = len(findings) - len(kept)
+        findings = kept
+
+    if args.format == "json":
+        print(json.dumps(
+            {"version": 1, "count": len(findings),
+             "baselined": baselined, "rules": rule_names(),
+             "findings": [f.as_dict() for f in findings]},
+            indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}:{f.col + 1}: [{f.rule}] {f.message}")
+            if f.snippet:
+                print(f"    {f.snippet}")
+        tail = f" ({baselined} baselined)" if baselined else ""
+        print(f"jsan: {len(findings)} finding(s){tail}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

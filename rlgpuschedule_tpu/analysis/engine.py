@@ -1,0 +1,320 @@
+"""jsan engine: file walking, the traced-region model, suppressions,
+and the committed-baseline workflow.
+
+The rules (:mod:`.rules`) are deliberately *local*: each looks only at
+one module's AST plus the shared :class:`ModuleContext` built here. The
+load-bearing piece of that context is the **traced-region model** — the
+set of function definitions whose bodies execute under a ``jax`` trace,
+where host syncs, Python control flow on tracers, and impure calls are
+hazards. A function is traced when the module itself shows the evidence:
+
+1. it is decorated with ``jax.jit`` / ``jax.pmap`` / ``partial(jax.jit,
+   ...)`` (or an equinox ``filter_jit``);
+2. its name is passed as a function argument to a tracing entry point
+   (``jax.jit``, ``jax.vmap``, ``jax.lax.scan``, ``jax.lax.cond``,
+   ``jax.grad``, ``shard_map``, ...);
+3. it is defined *inside* a traced function (closures trace with their
+   parent);
+4. it is defined inside a ``make_*`` factory — this repo's convention
+   (``make_train_step``, ``make_ppo_grad_step``, ``make_update_step``)
+   builds step functions that are jitted by a *different* module, so the
+   local evidence of (2) never appears; the naming convention is the
+   contract (README "Static analysis").
+
+Cross-module call graphs are out of scope: a helper that is only ever
+called from jitted code in another file is invisible to rules 1–3. That
+trades recall for precision — every finding points at local evidence —
+and the runtime sentinels (:mod:`.sentinels`) backstop the recall gap.
+
+Suppressions: ``# jsan: disable=<rule>[,<rule>...]  -- reason`` on the
+flagged line, or on a comment-only line directly above it (use the
+``--`` reason; an unexplained suppression is a review smell). Baseline:
+findings identified by ``(rule, path, snippet)`` — the *stripped source
+line*, not the line number, so the baseline survives unrelated edits
+above the finding.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable, Iterator
+
+# directories never descended into during a tree walk (explicit file
+# arguments are always analyzed — the analyzer's own test fixtures live
+# under tests/fixtures/ and are scanned on purpose, one file at a time)
+SKIP_DIRS = {"__pycache__", "fixtures", ".git", ".venv", "node_modules",
+             "build", "dist"}
+
+_SUPPRESS_RE = re.compile(r"#\s*jsan:\s*disable=([A-Za-z0-9_\-,]+)")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation. Baseline identity is ``(rule, path, snippet)``
+    (line numbers drift; the offending source line rarely does)."""
+    path: str       # as given on the command line, posix separators
+    line: int       # 1-based
+    col: int        # 0-based
+    rule: str
+    message: str
+    snippet: str    # stripped source line at ``line``
+
+    def as_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "rule": self.rule, "message": self.message,
+                "snippet": self.snippet}
+
+    @property
+    def baseline_key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+
+class SourceFile:
+    """Parsed module + per-line suppression table."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.suppressions = self._parse_suppressions()
+
+    def _parse_suppressions(self) -> dict[int, set[str]]:
+        out: dict[int, set[str]] = {}
+        for i, raw in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(raw)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            # a comment-only line suppresses the next line; an inline
+            # trailer suppresses its own line
+            target = i + 1 if raw.lstrip().startswith("#") else i
+            out.setdefault(target, set()).update(rules)
+        return out
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        active = self.suppressions.get(line, ())
+        return rule in active or "all" in active
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, node: ast.AST, rule: str, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(path=self.path, line=line, col=col, rule=rule,
+                       message=message, snippet=self.snippet(line))
+
+
+# ---------------------------------------------------------------------------
+# module context: import aliasing, parent links, traced regions
+
+# tracing entry points: a function passed (positionally) to any of these
+# executes under a trace
+_TRACING_ENTRY = {
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat", "jax.linearize", "jax.custom_jvp",
+    "jax.custom_vjp", "jax.lax.scan", "jax.lax.map", "jax.lax.cond",
+    "jax.lax.while_loop", "jax.lax.fori_loop", "jax.lax.switch",
+    "jax.lax.associative_scan", "jax.experimental.shard_map.shard_map",
+    "shard_map", "equinox.filter_jit",
+}
+
+_JIT_DECORATORS = {"jax.jit", "jax.pmap", "equinox.filter_jit"}
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class ModuleContext:
+    """Shared per-module analysis state handed to every rule."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.tree = src.tree
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.aliases = self._import_aliases()
+        self.functions_by_name: dict[str, list[ast.FunctionDef]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions_by_name.setdefault(node.name, []).append(node)
+        self.traced = self._traced_functions()
+
+    # -- imports ------------------------------------------------------------
+    def _import_aliases(self) -> dict[str, str]:
+        aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Canonical dotted name of a Name/Attribute chain, with import
+        aliases expanded (``jnp.mean`` -> ``jax.numpy.mean``, ``np.array``
+        -> ``numpy.array``). None for anything else (calls on calls,
+        subscripts, ...)."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(self.aliases.get(node.id, node.id))
+        return ".".join(reversed(parts))
+
+    def resolve_call(self, call: ast.Call) -> str | None:
+        return self.resolve(call.func)
+
+    # -- tree helpers -------------------------------------------------------
+    def enclosing_function(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, _FuncNode):
+            cur = self.parents.get(cur)
+        return cur
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    # -- traced-region model ------------------------------------------------
+    def _decorator_name(self, dec: ast.AST) -> str | None:
+        # @jax.jit / @partial(jax.jit, ...) / @functools.partial(jax.jit,..)
+        if isinstance(dec, ast.Call):
+            name = self.resolve(dec.func)
+            if name in ("functools.partial", "partial") and dec.args:
+                return self.resolve(dec.args[0])
+            return name
+        return self.resolve(dec)
+
+    def _traced_functions(self) -> set[ast.AST]:
+        roots: set[ast.AST] = set()
+        # (1) decorated tracing entry points
+        for fns in self.functions_by_name.values():
+            for fn in fns:
+                for dec in fn.decorator_list:
+                    name = self._decorator_name(dec)
+                    if name in _JIT_DECORATORS or name in _TRACING_ENTRY:
+                        roots.add(fn)
+        # (2) names passed to tracing entry points; lambdas likewise
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self.resolve_call(node)
+            if name not in _TRACING_ENTRY:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    roots.update(self.functions_by_name.get(arg.id, ()))
+                elif isinstance(arg, ast.Lambda):
+                    roots.add(arg)
+        # (4) defs inside a make_* factory (repo convention: factories
+        # return step functions jitted elsewhere — module docstring)
+        for fns in self.functions_by_name.values():
+            for fn in fns:
+                if fn.name.startswith("make_"):
+                    for child in ast.walk(fn):
+                        if child is not fn and isinstance(child, _FuncNode):
+                            roots.add(child)
+        # (3) closure propagation: defs nested inside traced functions
+        traced = set(roots)
+        for node in ast.walk(self.tree):
+            if isinstance(node, _FuncNode) and node not in traced:
+                if any(a in traced for a in self.ancestors(node)
+                       if isinstance(a, _FuncNode)):
+                    traced.add(node)
+        # fixpoint for deeper nesting (ast.walk order is not outer-first)
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(self.tree):
+                if isinstance(node, _FuncNode) and node not in traced:
+                    if any(a in traced for a in self.ancestors(node)
+                           if isinstance(a, _FuncNode)):
+                        traced.add(node)
+                        changed = True
+        return traced
+
+    def in_traced_region(self, node: ast.AST) -> bool:
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            if fn in self.traced:
+                return True
+            fn = self.enclosing_function(fn)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in SKIP_DIRS
+                                 and not d.startswith("."))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        else:
+            raise FileNotFoundError(path)
+
+
+def analyze_file(path: str, rules=None) -> list[Finding]:
+    from .rules import all_rules
+    rules = all_rules() if rules is None else rules
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    src = SourceFile(path.replace(os.sep, "/"), text)
+    ctx = ModuleContext(src)
+    findings: list[Finding] = []
+    for rule in rules:
+        for finding in rule.check(src, ctx):
+            if not src.suppressed(finding.line, finding.rule):
+                findings.append(finding)
+    return findings
+
+
+def analyze_paths(paths: Iterable[str], rules=None) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_py_files(paths):
+        findings.extend(analyze_file(path, rules))
+    return sorted(findings)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+def make_baseline(findings: Iterable[Finding]) -> dict:
+    entries = sorted({f.baseline_key for f in findings})
+    return {"version": 1,
+            "entries": [{"rule": r, "path": p, "snippet": s}
+                        for r, p, s in entries]}
+
+
+def load_baseline(path: str) -> set[tuple[str, str, str]]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {(e["rule"], e["path"], e["snippet"])
+            for e in data.get("entries", ())}
+
+
+def apply_baseline(findings: Iterable[Finding],
+                   baseline: set[tuple[str, str, str]]) -> list[Finding]:
+    return [f for f in findings if f.baseline_key not in baseline]

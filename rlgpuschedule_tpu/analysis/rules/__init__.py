@@ -1,0 +1,27 @@
+"""jsan rule registry. Each rule module exposes ``RULE``; the registry
+is the single source of truth for ``--list-rules`` and the default run.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable
+
+from ..engine import Finding, ModuleContext, SourceFile
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    summary: str
+    check: Callable[[SourceFile, ModuleContext], Iterable[Finding]]
+
+
+def all_rules() -> list[Rule]:
+    from . import (donation, host_sync, impure_in_jit, prng_reuse,
+                   recompile, tracer_leak)
+    return [donation.RULE, host_sync.RULE, tracer_leak.RULE,
+            impure_in_jit.RULE, recompile.RULE, prng_reuse.RULE]
+
+
+def rule_names() -> list[str]:
+    return [r.name for r in all_rules()]

@@ -1,0 +1,98 @@
+"""donation-discipline: a jitted state-threading callable must donate.
+
+The contract comes from ``algos/update.py``'s ``make_update_step``: a
+function of the shape ``f(state, ...) -> (state', ...)`` that is jitted
+*without* ``donate_argnums`` holds two live copies of the parameter +
+optimizer buffers on every call — on a TPU that is the difference
+between fitting the swept batch geometry in HBM and not. The rule fires
+on ``jax.jit(f, ...)`` calls (and ``@jax.jit`` decorations) where ``f``
+is resolvable in the module and *threads state*: some ``return``
+statement returns a tuple whose first element is the function's first
+parameter (rebinding the name along the way counts — that is exactly
+the threading idiom).
+
+Deliberate non-donation (e.g. the caller keeps the old state for a
+rollback path) is a one-line suppression with the reason inline:
+``jax.jit(step)  # jsan: disable=donation-discipline -- rollback keeps old state``
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+from ..engine import Finding, ModuleContext, SourceFile
+
+_DONATE_KW = {"donate_argnums", "donate_argnames"}
+
+
+def _first_param(fn: ast.AST) -> str | None:
+    args = fn.args
+    pos = args.posonlyargs + args.args
+    if not pos:
+        return None
+    first = pos[0].arg
+    # a method's self/cls is never the threaded state
+    if first in ("self", "cls") and len(pos) > 1:
+        return pos[1].arg
+    return first if first not in ("self", "cls") else None
+
+
+def threads_state(fn: ast.AST) -> bool:
+    """True when some return statement's tuple leads with the function's
+    first parameter name (the ``state, ... -> state', ...`` idiom)."""
+    first = _first_param(fn)
+    if first is None:
+        return False
+    if isinstance(fn, ast.Lambda):
+        body = fn.body
+        return (isinstance(body, ast.Tuple) and body.elts
+                and isinstance(body.elts[0], ast.Name)
+                and body.elts[0].id == first)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            continue  # nested scopes judged on their own
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Tuple):
+            elts = node.value.elts
+            if elts and isinstance(elts[0], ast.Name) and elts[0].id == first:
+                return True
+    return False
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    findings: list[Finding] = []
+    msg = ("jitted state-threading callable {name!r} does not donate its "
+           "state: pass donate_argnums=(0,) (make_update_step contract) "
+           "or suppress with the reason the old buffers must stay live")
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and ctx.resolve_call(node) == "jax.jit":
+            if any(kw.arg in _DONATE_KW for kw in node.keywords):
+                continue
+            if not node.args:
+                continue
+            target = node.args[0]
+            fns: list = []
+            if isinstance(target, ast.Name):
+                fns = ctx.functions_by_name.get(target.id, [])
+                label = target.id
+            elif isinstance(target, ast.Lambda):
+                fns, label = [target], "<lambda>"
+            if any(threads_state(f) for f in fns):
+                findings.append(src.finding(node, RULE.name,
+                                            msg.format(name=label)))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if (ctx._decorator_name(dec) == "jax.jit"
+                        and not (isinstance(dec, ast.Call)
+                                 and any(kw.arg in _DONATE_KW
+                                         for kw in dec.keywords))
+                        and threads_state(node)):
+                    findings.append(src.finding(dec, RULE.name,
+                                                msg.format(name=node.name)))
+    return findings
+
+
+RULE = Rule(
+    name="donation-discipline",
+    summary="jitted state-threading callables must pass donate_argnums",
+    check=_check)

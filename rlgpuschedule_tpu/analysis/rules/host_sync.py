@@ -1,0 +1,88 @@
+"""host-sync-in-hot-path: device values pulled to the host inside
+trace-reachable code.
+
+``.item()`` / ``.tolist()`` / ``float()`` / ``np.asarray()`` on a traced
+value either fails at trace time (ConcretizationTypeError) or — worse,
+when it survives on a concrete closure capture — silently bakes a
+host-device round trip or a trace-time constant into the compiled
+program. Inside the rollout/update hot path (one fused dispatch per
+iteration is the whole point — ``Experiment.run_fused``) a single such
+sync serializes the pipeline: the host blocks on the device instead of
+staying an iteration ahead.
+
+Only fires inside traced regions (engine docstring) — host-loop code is
+free to materialize scalars, that is where it belongs.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+from ..engine import Finding, ModuleContext, SourceFile
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_SYNC_CALLS = {"numpy.asarray", "numpy.array", "numpy.float32",
+               "numpy.float64", "numpy.int32", "numpy.int64",
+               "jax.device_get"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+def _param_names(ctx: ModuleContext, node: ast.AST) -> set[str]:
+    names: set[str] = set()
+    fn = ctx.enclosing_function(node)
+    while fn is not None:
+        a = fn.args
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])):
+            names.add(arg.arg)
+        fn = ctx.enclosing_function(fn)
+    return names
+
+
+def _roots_at_param(node: ast.AST, params: set[str]) -> bool:
+    """True when the expression is rooted at a function parameter (a
+    Name, or an attribute/subscript chain off one) — the value the trace
+    actually flows through."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id in params
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not ctx.in_traced_region(node):
+            continue
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SYNC_METHODS and not node.args:
+            findings.append(src.finding(
+                node, RULE.name,
+                f".{node.func.attr}() inside a trace-reachable function "
+                f"forces a host sync (or fails on a tracer); keep the "
+                f"value on device and materialize in the host loop"))
+            continue
+        name = ctx.resolve_call(node)
+        if name in _SYNC_CALLS:
+            if node.args and isinstance(node.args[0], ast.Constant):
+                continue  # np.float32(0.0)-style literals are host math
+            findings.append(src.finding(
+                node, RULE.name,
+                f"{name}() materializes a device value inside a "
+                f"trace-reachable function; use jnp (stays on device) or "
+                f"hoist the host conversion out of the jit region"))
+        elif name in _CAST_BUILTINS and len(node.args) == 1 \
+                and _roots_at_param(node.args[0],
+                                    _param_names(ctx, node)):
+            findings.append(src.finding(
+                node, RULE.name,
+                f"{name}() on a traced argument is a host sync at best "
+                f"and a ConcretizationTypeError at worst; use jnp casts "
+                f"(.astype) to stay on device"))
+    return findings
+
+
+RULE = Rule(
+    name="host-sync",
+    summary="host materialization (.item/float/np.asarray) in jit-reachable code",
+    check=_check)

@@ -1,0 +1,60 @@
+"""impure-in-jit: side effects and host entropy inside traced code.
+
+A jitted function body runs ONCE, at trace time. ``time.time()`` stamps
+the trace, not the step; ``np.random.*`` draws one host sample and bakes
+it into the compiled program as a constant (every subsequent call reuses
+it — the classic silently-wrong rollout); ``print`` fires at trace time
+only and then never again, which reads as "the code stopped running".
+Use ``jax.random`` with threaded keys for randomness,
+``jax.debug.print`` for tracing output, and host-side wall-clock timing
+around the dispatch (``utils.profiling``), never inside it.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+from ..engine import Finding, ModuleContext, SourceFile
+
+_IMPURE_CALLS = {
+    "time.time": "stamps trace time, not step time — time the dispatch "
+                 "from the host instead",
+    "time.perf_counter": "stamps trace time, not step time — time the "
+                         "dispatch from the host instead",
+    "time.monotonic": "stamps trace time, not step time",
+    "print": "fires once at trace time and never again; use "
+             "jax.debug.print",
+    "open": "host I/O inside a traced function runs at trace time only",
+    "input": "host I/O inside a traced function runs at trace time only",
+}
+_NP_RANDOM_PREFIX = "numpy.random."
+_PY_RANDOM_PREFIX = "random."
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not ctx.in_traced_region(node):
+            continue
+        name = ctx.resolve_call(node)
+        if name is None:
+            continue
+        if name in _IMPURE_CALLS:
+            findings.append(src.finding(
+                node, RULE.name,
+                f"{name}() in a trace-reachable function: "
+                f"{_IMPURE_CALLS[name]}"))
+        elif name.startswith(_NP_RANDOM_PREFIX) \
+                or name.startswith(_PY_RANDOM_PREFIX):
+            findings.append(src.finding(
+                node, RULE.name,
+                f"{name}() in a trace-reachable function draws ONE host "
+                f"sample at trace time and bakes it into the compiled "
+                f"program as a constant; thread a jax.random key instead"))
+    return findings
+
+
+RULE = Rule(
+    name="impure-in-jit",
+    summary="time/np.random/print/IO inside trace-reachable code",
+    check=_check)

@@ -1,0 +1,200 @@
+"""prng-key-reuse: the same PRNG key consumed by two jax.random calls.
+
+Reusing a key makes two "independent" draws bit-identical — in an RL
+trainer that means correlated action noise or identical minibatch
+permutations across epochs, a bug that changes no shapes, raises no
+error, and shifts training curves just enough to waste a tuning run.
+The contract is one consumption per key: ``key, sub = jax.random.split
+(key)`` then use ``sub`` exactly once.
+
+Detection is a linear, source-order scan per function scope:
+
+- a plain-Name first argument to any consuming ``jax.random.*`` call
+  (everything except ``PRNGKey``/``key_data``/``wrap_key_data``/
+  ``fold_in`` — fold_in derives without consuming) marks the name
+  consumed;
+- names assigned from a key-producing call (``PRNGKey``, ``split``,
+  ``fold_in``, tuple-unpacked or not) are **key variables**: passing
+  one to *any* call consumes it too — ``init_carry(..., key)`` followed
+  by ``net.init(key, ...)`` hands both consumers the same stream even
+  though neither is itself ``jax.random.*`` (the bug class this repo
+  actually had, in the multihost dryrun);
+- any rebinding of the name (assignment, tuple unpack, loop target)
+  clears it;
+- a second consumption while marked is a finding;
+- additionally, a consumption *inside a loop body* of a key that the
+  loop body never rebinds is a finding — the second consumption happens
+  at runtime, one iteration later.
+
+Attribute-rooted keys (``carry.key``) and cross-function flows are out
+of scope (precision over recall; the rollout threads keys through
+NamedTuples correctly and reads them back via split-and-rebind).
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+from ..engine import Finding, ModuleContext, SourceFile
+
+_NON_CONSUMING = {"PRNGKey", "key_data", "wrap_key_data", "key_impl",
+                  "fold_in", "clone"}
+_KEY_PRODUCERS = {"PRNGKey", "split", "fold_in", "clone", "key"}
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _produces_key(ctx: ModuleContext, value: ast.AST) -> bool:
+    """RHS expressions whose results are PRNG keys (possibly stacked)."""
+    if not isinstance(value, ast.Call):
+        return False
+    name = ctx.resolve_call(value)
+    return bool(name) and name.startswith("jax.random.") \
+        and name.rsplit(".", 1)[-1] in _KEY_PRODUCERS
+
+
+def _bound_names(target: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(target)
+            if isinstance(n, ast.Name)
+            and isinstance(n.ctx, (ast.Store, ast.Del))}
+
+
+class _ScopeScanner:
+    """Source-order scan of one function (or module) body, not descending
+    into nested function scopes."""
+
+    def __init__(self, src: SourceFile, ctx: ModuleContext):
+        self.src = src
+        self.ctx = ctx
+        self.consumed: dict[str, ast.Call] = {}
+        self.key_names: set[str] = set()
+        self.findings: list[Finding] = []
+
+    def _consumptions(self, call: ast.Call) -> list[str]:
+        """Key names this call consumes."""
+        name = self.ctx.resolve_call(call)
+        if name and name.startswith("jax.random."):
+            if name.rsplit(".", 1)[-1] in _NON_CONSUMING:
+                return []
+            if call.args and isinstance(call.args[0], ast.Name):
+                return [call.args[0].id]
+            for kw in call.keywords:
+                if kw.arg == "key" and isinstance(kw.value, ast.Name):
+                    return [kw.value.id]
+            return []
+        # generic call: any known key variable handed over is consumed by
+        # whatever randomness the callee draws from it
+        out = []
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in self.key_names:
+                out.append(arg.id)
+        return out
+
+    def scan_body(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, _FN):
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            is_key = stmt.value is not None \
+                and _produces_key(self.ctx, stmt.value)
+            for t in targets:
+                for name in _bound_names(t):
+                    self.consumed.pop(name, None)
+                    (self.key_names.add if is_key
+                     else self.key_names.discard)(name)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter)
+            loop_bound = _bound_names(stmt.target)
+            for sub in ast.walk(stmt):
+                if sub is not stmt and isinstance(
+                        sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for t in targets:
+                        loop_bound |= _bound_names(t)
+            self._loop_body(stmt.body + stmt.orelse, loop_bound)
+            return
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test)
+            loop_bound: set[str] = set()
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.Assign, ast.AugAssign,
+                                    ast.AnnAssign)):
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for t in targets:
+                        loop_bound |= _bound_names(t)
+            self._loop_body(stmt.body + stmt.orelse, loop_bound)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        # generic statement: scan child statements recursively, child
+        # expressions linearly
+        for field in ast.iter_child_nodes(stmt):
+            if isinstance(field, ast.stmt):
+                self._stmt(field)
+            elif isinstance(field, ast.expr):
+                self._expr(field)
+
+    def _loop_body(self, body: list[ast.stmt], loop_bound: set[str]) -> None:
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, _FN):
+                    break
+                if isinstance(node, ast.Call):
+                    for key in self._consumptions(node):
+                        if key not in loop_bound:
+                            self.findings.append(self.src.finding(
+                                node, RULE.name,
+                                f"PRNG key {key!r} is consumed inside a "
+                                f"loop body that never rebinds it: every "
+                                f"iteration draws the SAME randomness; "
+                                f"split the key per iteration"))
+            self._stmt(stmt)
+
+    def _expr(self, expr: ast.expr) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, _FN):
+                continue
+            if isinstance(node, ast.Call):
+                for key in self._consumptions(node):
+                    if key in self.consumed:
+                        self.findings.append(self.src.finding(
+                            node, RULE.name,
+                            f"PRNG key {key!r} already consumed at line "
+                            f"{self.consumed[key].lineno}; reusing it "
+                            f"hands two consumers the same stream (the "
+                            f"draws are bit-identical) — split first"))
+                    else:
+                        self.consumed[key] = node
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    findings: list[Finding] = []
+    scopes: list[list[ast.stmt]] = [ctx.tree.body]
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes.append(node.body)
+    seen: set[tuple[int, int]] = set()
+    for body in scopes:
+        scanner = _ScopeScanner(src, ctx)
+        scanner.scan_body(body)
+        for f in scanner.findings:
+            if (f.line, f.col) not in seen:
+                seen.add((f.line, f.col))
+                findings.append(f)
+    return findings
+
+
+RULE = Rule(
+    name="prng-key-reuse",
+    summary="same PRNG key consumed by two jax.random calls without a split",
+    check=_check)

@@ -1,0 +1,98 @@
+"""recompile-hazard: jit cache misses by construction.
+
+``jax.jit``'s cache is keyed on the *function object* plus abstract
+argument signature. Two constructions defeat it outright:
+
+- ``jax.jit(lambda ...: ...)`` (or a nested ``def``) evaluated inside a
+  function body: every call of the enclosing function builds a fresh
+  function object, so every call compiles from scratch — the hazard
+  ``parallel/pbt.py``'s ``_GATHER_CACHE`` exists to avoid.
+- any ``jax.jit(...)`` call inside a ``for``/``while`` loop body: one
+  compile per loop iteration.
+
+Caching the jitted callable exempts the pattern: an assignment whose
+target includes an attribute or subscript (``self._fused_jit = ...``,
+``_CACHE[key] = ...``) is recognized as the memoization idiom. The
+stealthier recompile causes — unhashable/Python-scalar closure captures,
+shape-unstable arguments — are not statically decidable here; the
+runtime compile-count sentinel (``analysis.sentinels.CompileCounter``)
+owns that half of the contract.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+from ..engine import Finding, ModuleContext, SourceFile
+
+_JIT_CALLS = {"jax.jit", "jax.pmap"}
+
+
+def _is_cached_assignment(ctx: ModuleContext, call: ast.Call) -> bool:
+    """True when the jit result is stored through an attribute/subscript
+    target (memoized on an object or in a cache dict)."""
+    node = call
+    for parent in ctx.ancestors(call):
+        if isinstance(parent, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (parent.targets if isinstance(parent, ast.Assign)
+                       else [parent.target])
+            return any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in targets)
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda, ast.Module)):
+            return False
+        node = parent
+    return False
+
+
+def _in_loop(ctx: ModuleContext, node: ast.AST) -> bool:
+    for parent in ctx.ancestors(node):
+        if isinstance(parent, (ast.For, ast.While)):
+            return True
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            # a loop *outside* the enclosing function doesn't re-run this
+            # statement per iteration unless the function is re-called —
+            # which the fresh-function-object check already covers
+            return False
+    return False
+
+
+def _nested_defs(fn: ast.AST) -> set[str]:
+    return {n.name for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn}
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) \
+                or ctx.resolve_call(node) not in _JIT_CALLS:
+            continue
+        if _in_loop(ctx, node):
+            findings.append(src.finding(
+                node, RULE.name,
+                "jax.jit inside a loop body compiles once per iteration; "
+                "hoist the jit out of the loop"))
+            continue
+        fn = ctx.enclosing_function(node)
+        if fn is None or _is_cached_assignment(ctx, node):
+            continue
+        target = node.args[0] if node.args else None
+        fresh = isinstance(target, ast.Lambda) or (
+            isinstance(target, ast.Name) and target.id in _nested_defs(fn))
+        if fresh:
+            findings.append(src.finding(
+                node, RULE.name,
+                "jax.jit of a function object created per call (lambda / "
+                "nested def) defeats the jit cache: every call of the "
+                "enclosing function recompiles; hoist the target to "
+                "module scope or memoize the jitted callable"))
+    return findings
+
+
+RULE = Rule(
+    name="recompile-hazard",
+    summary="jit-of-fresh-lambda / jit-in-loop defeats the compile cache",
+    check=_check)

@@ -1,0 +1,66 @@
+"""tracer-leak: Python control flow on traced values.
+
+``if jnp.any(mask):`` inside a jitted function is not a device branch —
+it concretizes the tracer (error) or, on a concrete capture, freezes one
+branch into the compiled program forever. The device-side forms are
+``jnp.where`` / ``lax.cond`` / ``lax.select``. The rule fires on
+``if`` / ``while`` / ``assert`` / conditional-expression tests inside
+traced regions whose test expression contains a jax/jnp/np call or an
+array-reduction method call (``.any()``, ``.all()``, ``.sum()``, ...) —
+deliberately conservative: ``if config.bf16_update:`` (static Python
+config) is the dominant legitimate branch idiom in this codebase and
+never matches.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+from ..engine import Finding, ModuleContext, SourceFile
+
+_REDUCTIONS = {"any", "all", "sum", "min", "max", "mean", "item"}
+_TRACED_PREFIXES = ("jax.", "numpy.")
+
+
+def _test_is_traced(ctx: ModuleContext, test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.resolve_call(node)
+        if name and (name.startswith(_TRACED_PREFIXES) or name == "jax"):
+            return True
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _REDUCTIONS and not node.args:
+            return True
+    return False
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        test = None
+        kind = None
+        if isinstance(node, ast.If):
+            test, kind = node.test, "if"
+        elif isinstance(node, ast.While):
+            test, kind = node.test, "while"
+        elif isinstance(node, ast.Assert):
+            test, kind = node.test, "assert"
+        elif isinstance(node, ast.IfExp):
+            test, kind = node.test, "conditional expression"
+        if test is None or not ctx.in_traced_region(node):
+            continue
+        if _test_is_traced(ctx, test):
+            findings.append(src.finding(
+                node, RULE.name,
+                f"Python {kind} on a traced expression inside a "
+                f"trace-reachable function: this concretizes the tracer "
+                f"(error) or freezes one branch at trace time; use "
+                f"jnp.where / lax.cond / lax.select"))
+    return findings
+
+
+RULE = Rule(
+    name="tracer-leak",
+    summary="Python if/while/assert on traced expressions in jitted code",
+    check=_check)

@@ -1,0 +1,16 @@
+"""Known-bad: state-threading jit without donation (2 findings)."""
+import jax
+
+
+def update(state, batch):
+    state = state + batch.mean()
+    return state, {"loss": batch.mean()}
+
+
+step = jax.jit(update)              # finding: no donate_argnums
+
+
+@jax.jit                             # finding: decorator form, no donate
+def train_step(state, x):
+    state = state * x
+    return state, x

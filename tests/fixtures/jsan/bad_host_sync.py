@@ -1,0 +1,27 @@
+"""Known-bad: host syncs inside trace-reachable functions (4 findings)."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def loss_scalar(params, batch):
+    loss = (params * batch).sum()
+    return loss.item()                  # finding: .item() in jit
+
+
+def make_train_step(apply_fn):
+    def train_step(state, batch):
+        pred = apply_fn(state, batch)
+        host = np.asarray(pred)         # finding: np.asarray in factory step
+        scale = float(batch)            # finding: float() on traced arg
+        return state, host * scale
+
+    return train_step
+
+
+def body(carry, x):
+    return carry, np.array(x)           # finding: np.array in scanned body
+
+
+def scan_it(xs):
+    return jax.lax.scan(body, 0.0, xs)

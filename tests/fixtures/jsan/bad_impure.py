@@ -1,0 +1,13 @@
+"""Known-bad: host entropy / side effects in traced code (3 findings)."""
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def noisy_update(state, batch):
+    noise = np.random.normal(size=batch.shape)   # finding: baked-in sample
+    t0 = time.time()                             # finding: trace-time stamp
+    print("updating", t0)                        # finding: trace-time print
+    return state + batch + noise

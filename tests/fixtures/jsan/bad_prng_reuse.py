@@ -1,0 +1,21 @@
+"""Known-bad: PRNG key reuse (3 findings)."""
+import jax
+
+
+def sample_pair(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))    # finding: key already consumed
+    return a, b
+
+
+def shuffle_twice(key, xs):
+    perm1 = jax.random.permutation(key, xs)
+    perm2 = jax.random.permutation(key, xs)   # finding: identical perms
+    return perm1, perm2
+
+
+def loop_draws(key, n):
+    out = []
+    for _ in range(n):
+        out.append(jax.random.normal(key))    # finding: same draw per iter
+    return out

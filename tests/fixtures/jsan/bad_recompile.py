@@ -1,0 +1,16 @@
+"""Known-bad: jit cache defeated by construction (2 findings — the
+jit-in-loop finding subsumes the fresh-lambda one on the same call)."""
+import jax
+import jax.numpy as jnp
+
+
+def copy_tree(tree):
+    # fresh lambda per call -> fresh cache entry per call
+    return jax.jit(lambda t: jax.tree.map(jnp.copy, t))(tree)
+
+
+def train(batches, state):
+    for batch in batches:
+        step = jax.jit(lambda s, b: s + b)   # findings: jit in loop body
+        state = step(state, batch)           # (loop + fresh lambda)
+    return state

@@ -1,0 +1,21 @@
+"""Known-bad: Python control flow on traced values (3 findings)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp(x):
+    if jnp.any(x > 1.0):                # finding: if on traced expr
+        x = jnp.clip(x, -1.0, 1.0)
+    while jnp.sum(x) > 10.0:            # finding: while on traced expr
+        x = x * 0.5
+    return x
+
+
+def make_step(apply_fn):
+    def step(state, batch):
+        out = apply_fn(state, batch)
+        assert jnp.all(out >= 0)        # finding: assert on traced expr
+        return state, out
+
+    return step

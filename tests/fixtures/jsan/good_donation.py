@@ -1,0 +1,27 @@
+"""Known-good donation discipline (0 findings)."""
+import jax
+
+
+def update(state, batch):
+    state = state + batch.mean()
+    return state, {"loss": batch.mean()}
+
+
+step = jax.jit(update, donate_argnums=(0,))
+
+
+def project(params, x):
+    # not state-threading: nothing returned leads with the first param
+    return x @ params["w"]
+
+
+infer = jax.jit(project)
+
+
+def rollback_update(state, batch):
+    state = state + batch
+    return state, batch
+
+
+# deliberate non-donation, documented inline
+keep = jax.jit(rollback_update)  # jsan: disable=donation-discipline -- rollback keeps the old state live

@@ -1,0 +1,16 @@
+"""Known-good: host materialization stays in the host loop (0 findings)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def loss_fn(params, batch):
+    return (params * batch).sum().astype(jnp.float32)
+
+
+def host_loop(params, batches):
+    for batch in batches:
+        loss = loss_fn(params, batch)
+        # host code may materialize freely — not trace-reachable
+        print(float(np.asarray(loss)))

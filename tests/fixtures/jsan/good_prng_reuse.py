@@ -1,0 +1,28 @@
+"""Known-good: one consumption per key (0 findings)."""
+import jax
+
+
+def sample_pair(key):
+    ka, kb = jax.random.split(key)
+    return jax.random.normal(ka, (4,)), jax.random.uniform(kb, (4,))
+
+
+def resplit(key):
+    key, sub = jax.random.split(key)      # key rebound by the same stmt
+    x = jax.random.normal(sub)
+    key, sub = jax.random.split(key)      # fine: key was rebound above
+    return x + jax.random.normal(sub), key
+
+
+def loop_draws(key, n):
+    out = []
+    for _ in range(n):
+        key, sub = jax.random.split(key)  # rebound inside the loop body
+        out.append(jax.random.normal(sub))
+    return out
+
+
+def derived(key, i):
+    # fold_in derives without consuming; reuse afterwards is legal
+    per_step = jax.random.fold_in(key, i)
+    return jax.random.normal(per_step), jax.random.normal(key)

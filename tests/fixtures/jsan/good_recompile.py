@@ -1,0 +1,29 @@
+"""Known-good: jit at module scope or memoized (0 findings)."""
+import jax
+import jax.numpy as jnp
+
+_copy_tree = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+
+_CACHE: dict = {}
+
+
+def _double(x):
+    return x * 2
+
+
+def cached_double(x):
+    # memoization idiom: jit result stored through a subscript target
+    fn = _CACHE.get("double")
+    if fn is None:
+        fn = _CACHE["double"] = jax.jit(_double)
+    return fn(x)
+
+
+class Runner:
+    def __init__(self):
+        self._step = None
+
+    def run(self, state, batch):
+        if self._step is None:
+            self._step = jax.jit(_double)   # attribute target: memoized
+        return self._step(state) + batch

@@ -1,0 +1,28 @@
+"""Known-good: static Python branches + device-side selects (0 findings)."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    use_bf16: bool = False
+    n_layers: int = 2
+
+
+@jax.jit
+def select(x):
+    return jnp.where(x > 1.0, jnp.clip(x, -1.0, 1.0), x)
+
+
+def make_step(config: Config):
+    def step(state, batch):
+        # static config branch: decided at trace time, on purpose
+        if config.use_bf16:
+            batch = batch.astype(jnp.bfloat16)
+        for _ in range(config.n_layers):
+            state = state * batch
+        return state, batch
+
+    return step

@@ -1,0 +1,209 @@
+"""jsan static-analyzer tests (PR 3): one known-good + known-bad fixture
+pair per rule, suppression + baseline workflows, JSON output stability,
+and the two acceptance gates — the shipped tree is clean, and seeding
+any known-bad snippet into a tree makes the CLI exit nonzero.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from rlgpuschedule_tpu.analysis import (analyze_paths, apply_baseline,
+                                        make_baseline)
+from rlgpuschedule_tpu.analysis.engine import SKIP_DIRS, iter_py_files
+from rlgpuschedule_tpu.analysis.rules import rule_names
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "jsan")
+
+# rule -> (bad fixture, expected finding count in it)
+BAD = {
+    "donation-discipline": ("bad_donation.py", 2),
+    "host-sync": ("bad_host_sync.py", 4),
+    "tracer-leak": ("bad_tracer_leak.py", 3),
+    "impure-in-jit": ("bad_impure.py", 3),
+    "recompile-hazard": ("bad_recompile.py", 2),
+    "prng-key-reuse": ("bad_prng_reuse.py", 3),
+}
+GOOD = ["good_donation.py", "good_host_sync.py", "good_tracer_leak.py",
+        "good_impure.py", "good_recompile.py", "good_prng_reuse.py"]
+
+
+def _cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "rlgpuschedule_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=cwd,
+        env={**os.environ, "PYTHONPATH": REPO})
+
+
+class TestRules:
+    @pytest.mark.parametrize("rule", sorted(BAD))
+    def test_bad_fixture_fires_the_rule(self, rule):
+        fname, expected = BAD[rule]
+        findings = analyze_paths([os.path.join(FIXTURES, fname)])
+        assert len(findings) == expected, findings
+        assert {f.rule for f in findings} == {rule}, findings
+
+    @pytest.mark.parametrize("fname", GOOD)
+    def test_good_fixture_is_clean(self, fname):
+        assert analyze_paths([os.path.join(FIXTURES, fname)]) == []
+
+    def test_registry_covers_every_fixture_rule(self):
+        assert set(BAD) == set(rule_names())
+
+
+class TestSuppressions:
+    def test_inline_suppression_silences_one_rule(self, tmp_path):
+        bad = open(os.path.join(FIXTURES, "bad_prng_reuse.py")).read()
+        patched = bad.replace(
+            "b = jax.random.uniform(key, (4,))",
+            "b = jax.random.uniform(key, (4,))  "
+            "# jsan: disable=prng-key-reuse -- test")
+        p = tmp_path / "patched.py"
+        p.write_text(patched)
+        findings = analyze_paths([str(p)])
+        assert len(findings) == BAD["prng-key-reuse"][1] - 1
+
+    def test_comment_line_above_suppresses_next_line(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text(
+            "import jax\n\n\n"
+            "def f(key):\n"
+            "    a = jax.random.normal(key)\n"
+            "    # jsan: disable=prng-key-reuse -- deliberate twin draw\n"
+            "    b = jax.random.normal(key)\n"
+            "    return a, b\n")
+        assert analyze_paths([str(p)]) == []
+
+    def test_unrelated_rule_name_does_not_suppress(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text(
+            "import jax\n\n\n"
+            "def f(key):\n"
+            "    a = jax.random.normal(key)\n"
+            "    b = jax.random.normal(key)  # jsan: disable=host-sync\n"
+            "    return a, b\n")
+        assert [f.rule for f in analyze_paths([str(p)])] \
+            == ["prng-key-reuse"]
+
+
+class TestWalker:
+    def test_fixture_dirs_are_skipped_in_tree_walks(self):
+        assert "fixtures" in SKIP_DIRS
+        walked = list(iter_py_files([os.path.join(REPO, "tests")]))
+        assert not any("fixtures" in p for p in walked)
+        # but explicit file arguments are always analyzed
+        explicit = os.path.join(FIXTURES, "bad_impure.py")
+        assert list(iter_py_files([explicit])) == [explicit]
+
+
+class TestCLI:
+    def test_shipped_tree_is_clean(self):
+        """Acceptance gate: the analyzer exits 0 over the shipped
+        package + top-level scripts (everything fixed or suppressed)."""
+        r = _cli("rlgpuschedule_tpu", "bench.py", "__graft_entry__.py")
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_seeded_bad_snippet_fails_the_tree(self, tmp_path):
+        """Acceptance gate: seeding any one known-bad fixture into an
+        otherwise-clean tree makes the CLI exit nonzero."""
+        tree = tmp_path / "pkg"
+        tree.mkdir()
+        shutil.copy(os.path.join(FIXTURES, "good_donation.py"),
+                    tree / "clean.py")
+        r = _cli(str(tree), cwd=str(tmp_path))
+        assert r.returncode == 0, r.stdout + r.stderr
+        shutil.copy(os.path.join(FIXTURES, "bad_host_sync.py"),
+                    tree / "seeded.py")
+        r = _cli(str(tree), cwd=str(tmp_path))
+        assert r.returncode == 1
+        assert "[host-sync]" in r.stdout
+
+    def test_json_output_is_stable_and_sorted(self):
+        paths = [os.path.join(FIXTURES, f) for f, _ in
+                 (BAD["prng-key-reuse"], BAD["recompile-hazard"])]
+        r1 = _cli(*paths, "--format", "json", "--no-baseline")
+        r2 = _cli(*reversed(paths), "--format", "json", "--no-baseline")
+        assert r1.returncode == r2.returncode == 1
+        out1, out2 = json.loads(r1.stdout), json.loads(r2.stdout)
+        assert out1 == out2            # argument order doesn't matter
+        keys = [(f["path"], f["line"], f["col"], f["rule"])
+                for f in out1["findings"]]
+        assert keys == sorted(keys)    # sorted output
+        assert out1["count"] == len(out1["findings"])
+
+    def test_list_rules(self):
+        r = _cli("--list-rules")
+        assert r.returncode == 0
+        for name in rule_names():
+            assert name in r.stdout
+
+
+class TestBaseline:
+    def test_baseline_round_trips(self, tmp_path):
+        """--write-baseline over a dirty tree, then a normal run with
+        that baseline, exits 0; and the baseline file itself is stable
+        (sorted, deterministic) across regenerations."""
+        bad = os.path.join(FIXTURES, "bad_tracer_leak.py")
+        base = tmp_path / "baseline.json"
+        r = _cli(bad, "--write-baseline", str(base))
+        assert r.returncode == 0, r.stdout + r.stderr
+        first = base.read_text()
+        r = _cli(bad, "--baseline", str(base))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "baselined" in r.stdout
+        r = _cli(bad, "--write-baseline", str(base))
+        assert base.read_text() == first           # byte-stable
+        entries = json.loads(first)["entries"]
+        assert entries == sorted(
+            entries, key=lambda e: (e["rule"], e["path"], e["snippet"]))
+
+    def test_baseline_survives_line_drift(self, tmp_path):
+        """Identity is (rule, path, snippet): inserting lines above a
+        grandfathered finding must not resurrect it."""
+        src = open(os.path.join(FIXTURES, "bad_recompile.py")).read()
+        p = tmp_path / "mod.py"
+        p.write_text(src)
+        findings = analyze_paths([str(p)])
+        assert findings
+        baseline = {(f.rule, f.path, f.snippet) for f in findings}
+        p.write_text("# pushed\n# down\n# three lines\n" + src)
+        drifted = analyze_paths([str(p)])
+        assert [f.line for f in drifted] != [f.line for f in findings]
+        assert apply_baseline(drifted, baseline) == []
+
+    def test_new_findings_are_not_masked_by_baseline(self, tmp_path):
+        findings = analyze_paths(
+            [os.path.join(FIXTURES, "bad_impure.py")])
+        baseline = {f.baseline_key for f in findings[:1]}
+        kept = apply_baseline(findings, baseline)
+        assert len(kept) == len(findings) - 1
+
+    def test_make_baseline_matches_engine_format(self):
+        findings = analyze_paths(
+            [os.path.join(FIXTURES, "bad_donation.py")])
+        data = make_baseline(findings)
+        assert data["version"] == 1
+        assert all(set(e) == {"rule", "path", "snippet"}
+                   for e in data["entries"])
+
+
+class TestRepoBaselineFile:
+    def test_committed_baseline_is_valid_and_minimal(self):
+        """The committed jsan_baseline.json must parse and contain only
+        entries that still match a real finding — stale grandfather
+        entries hide future regressions at the same line."""
+        path = os.path.join(REPO, "jsan_baseline.json")
+        with open(path) as f:
+            data = json.load(f)
+        assert data["version"] == 1
+        current = {f.baseline_key for f in analyze_paths(
+            [os.path.join(REPO, "rlgpuschedule_tpu"),
+             os.path.join(REPO, "bench.py"),
+             os.path.join(REPO, "__graft_entry__.py")])}
+        stale = [e for e in data["entries"]
+                 if (e["rule"], e["path"], e["snippet"]) not in current]
+        assert stale == [], f"stale baseline entries: {stale}"
