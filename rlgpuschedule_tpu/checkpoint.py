@@ -31,6 +31,12 @@ class CheckpointRestoreError(RuntimeError):
     truncation across the whole rotation window)."""
 
 
+# module-level jit: a fresh `jax.jit(lambda ...)` per restore would defeat
+# the jit cache and recompile the copy program on every rollback
+# (jsan recompile-hazard, PR 3 first-run finding)
+_fresh_copy_jit = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+
+
 def _fresh_copy(tree: Any) -> Any:
     """Copy every restored array into a fresh device buffer. Orbax-restored
     buffers must NOT be donated back into a jitted step (donate_argnums):
@@ -38,7 +44,7 @@ def _fresh_copy(tree: Any) -> Any:
     restore-then-run resume tests segfaulted the whole suite). One jitted
     copy decouples the training state from the restore machinery's
     buffers; sharding is preserved (copy is elementwise)."""
-    return jax.jit(lambda t: jax.tree.map(jnp.copy, t))(tree)
+    return _fresh_copy_jit(tree)
 
 
 def _state_tree(state: TrainState, key: jax.Array | None,
