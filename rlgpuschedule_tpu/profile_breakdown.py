@@ -212,7 +212,12 @@ def main(argv: list[str] | None = None) -> dict:
     exp = Experiment.build(cfg)
     env_params, apply_fn = exp.env_params, exp.apply_fn
     state, carry, traces = exp.train_state, exp.carry, exp.traces
+    # one key per consumer: the sweep, the standalone update timing, and
+    # the fused-step warmup each get their own stream (jsan
+    # prng-key-reuse: handing two consumers the same key makes their
+    # draws bit-identical); the fused timing loop splits `key` itself
     key = jax.random.PRNGKey(0)
+    key, k_sweep, k_upd, k_warm = jax.random.split(key, 4)
     B = n_steps * n_envs
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
     kind = getattr(jax.devices()[0], "device_kind", "").lower()
@@ -233,6 +238,14 @@ def main(argv: list[str] | None = None) -> dict:
                                ppo.gamma, ppo.gae_lambda)
         return normalize_advantages(adv), ret
 
+    # ONE jitted copy program shared by every _timed_update call: the
+    # sweep times a dozen geometries, and a fresh jax.jit(lambda) per
+    # call would recompile the copy once per geometry (jsan
+    # recompile-hazard, PR 3 first-run finding). Can't live at module
+    # scope — jax is imported lazily so --cpu can pin the platform first.
+    copy_state = jax.jit(  # jsan: disable=recompile-hazard -- built once per process; jax import is deferred
+        lambda t: jax.tree.map(jnp.copy, t))
+
     def _timed_update(ppo_g, state0, tr, adv, ret, key, n):
         """Median seconds/iteration of the donated update step at geometry
         ``ppo_g``, threading the donated state like the production loop."""
@@ -240,7 +253,7 @@ def main(argv: list[str] | None = None) -> dict:
             lambda s, t, a, r, k: run_ppo_epochs(
                 apply_fn, ppo_g, s, t, a, r, k,
                 lambda st, g: st.apply_gradients(grads=g)))
-        cell = {"s": jax.jit(lambda t: jax.tree.map(jnp.copy, t))(state0)}
+        cell = {"s": copy_state(state0)}
         cell["s"], _ = jax.block_until_ready(
             upd(cell["s"], tr, adv, ret, key))         # compile + warm
 
@@ -258,17 +271,18 @@ def main(argv: list[str] | None = None) -> dict:
     n = args.iters_per_repeat
     if args.sweep_minibatch:
         out = _sweep_minibatch(args, ppo, platform, kind, peak, B, n_params,
-                               _timed_update, state, tr, adv, ret, key, n)
+                               _timed_update, state, tr, adv, ret, k_sweep,
+                               n)
         print(json.dumps(out))
         if args.sweep_out:
             with open(args.sweep_out, "w") as f:
                 json.dump(out, f, indent=1)
         return out
 
-    t_upd = _timed_update(ppo, state, tr, adv, ret, key, n)
+    t_upd = _timed_update(ppo, state, tr, adv, ret, k_upd, n)
 
     fused = exp.train_step     # the production jit (donates; returns fresh)
-    state2, carry2, _ = fused(state, carry, traces, key)
+    state2, carry2, _ = fused(state, carry, traces, k_warm)
     jax.block_until_ready(state2.params)
     state, carry = state2, carry2   # donated originals are dead now
 
