@@ -191,10 +191,15 @@ def main(argv: list[str] | None = None) -> None:
     net = make_policy("flat", env_params.n_actions)
     apply_fn = lambda p, o, m: net.apply(p, o, m)
     cfg = PPOConfig(n_steps=8, n_epochs=1, n_minibatches=2)
-    key = jax.random.PRNGKey(0)
+    # distinct streams for the rollout carry and the param init (jsan
+    # prng-key-reuse, PR 3 first-run finding: the same PRNGKey(0) fed the
+    # carry, the global carry assembly, AND net.init — action sampling
+    # and weight draws shared one stream). Every rank computes the same
+    # split, so the cross-rank fingerprint contract is untouched.
+    carry_key, init_key = jax.random.split(jax.random.PRNGKey(0))
     # carry init needs a local-shape trace: init on the local shard, then
     # assemble the global carry the same way the traces were assembled
-    local_carry = init_carry(env_params, local_traces, key)
+    local_carry = init_carry(env_params, local_traces, carry_key)
     carry = dp.RolloutCarry(
         env_state=multihost.global_traces(
             mesh, jax.tree.map(np.asarray, local_carry.env_state), n_envs),
@@ -202,8 +207,8 @@ def main(argv: list[str] | None = None) -> None:
             mesh, np.asarray(local_carry.obs), n_envs),
         mask=multihost.global_traces(
             mesh, np.asarray(local_carry.mask), n_envs),
-        key=key)
-    params = net.init(key, np.asarray(local_carry.obs[:1]),
+        key=local_carry.key)
+    params = net.init(init_key, np.asarray(local_carry.obs[:1]),
                       np.asarray(local_carry.mask[:1]))
     state = TrainState.create(apply_fn=net.apply, params=params,
                               tx=make_optimizer(cfg))
