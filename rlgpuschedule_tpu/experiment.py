@@ -12,12 +12,11 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
-from .algos import (A2CConfig, PPOConfig, init_carry, make_a2c_step,
-                    make_ppo_step, make_train_state, resolve_geometry)
+from .algos import (init_carry, make_a2c_step, make_ppo_step,
+                    make_train_state, resolve_geometry)
 from .algos.ppo import make_optimizer
 from .configs import ExperimentConfig
 from .env import EnvParams, build_adjacency, stack_traces
-from .env import env as env_lib
 from .models import make_policy
 from .sim.core import SimParams, validate_trace
 from .traces import (ArrayTrace, gen_poisson_trace, load_pai, load_philly)
@@ -458,18 +457,25 @@ class Experiment:
                     self.train_state, self.carry, self.traces, sub)
             if injector is not None:
                 metrics = injector.poison_nan(self, b, metrics)
+            log_hit = log_every and (
+                (b + 1) % log_every == 0 if fused_chunk > 1
+                else b % log_every == 0)
+            want_log = bool(log_every) and (log_hit or b == iterations - 1)
+            # host consumers (watchdog + logger) share ONE batched
+            # device_get: per-field float() is a separate blocking
+            # transfer each, and the watchdog path pays it every
+            # iteration (jsan host-sync review, PR 3)
+            m = None
+            if watchdog is not None or want_log:
+                m = {k: float(v) for k, v in
+                     jax.device_get(metrics)._asdict().items()}
             if watchdog is not None:
-                m = {k: float(v) for k, v in metrics._asdict().items()}
                 reason = watchdog.check(m)
                 if reason is not None:
                     event = watchdog.rollback(self, ckpt, b, reason)
                     i = event.resume_iteration
                     continue
-            log_hit = log_every and (
-                (b + 1) % log_every == 0 if fused_chunk > 1
-                else b % log_every == 0)
-            if log_every and (log_hit or b == iterations - 1):
-                m = {k: float(v) for k, v in metrics._asdict().items()}
+            if want_log:
                 history.append({"iteration": b, **m})
                 if logger is not None:
                     logger(b, m)
@@ -723,9 +729,12 @@ class PopulationExperiment:
                 self.states, self.hparams, _decision = out
             if log_every and (i % log_every == 0 or i == iterations - 1):
                 # flatten per-member values to suffixed scalar columns so
-                # the CSV stays pandas/TensorBoard-ingestible (ADVICE r1)
+                # the CSV stays pandas/TensorBoard-ingestible (ADVICE r1).
+                # ONE batched device_get for the whole [P]-metrics tuple:
+                # per-element float() was n_fields x P separate blocking
+                # transfers per logged iteration (jsan host-sync review)
                 m = {}
-                for k, v in metrics._asdict().items():
+                for k, v in jax.device_get(metrics)._asdict().items():
                     vals = [float(x) for x in v]
                     m.update({f"{k}_{p}": x for p, x in enumerate(vals)})
                     m[f"{k}_mean"] = sum(vals) / len(vals)

@@ -19,6 +19,8 @@ import math
 import sys
 from typing import Any
 
+import numpy as np
+
 
 class DivergenceError(RuntimeError):
     """The run diverged more times than ``max_rollbacks`` allows."""
@@ -87,7 +89,10 @@ class DivergenceWatchdog:
         re-seeds it from the best member); the watchdog only rolls back
         the catastrophic case where NO member has finite fitness — there
         is nobody left to re-seed from."""
-        vals = [float(v) for v in fitness]
+        # ONE batched device read: per-element float() on a device array
+        # issues a separate blocking transfer per member, every iteration
+        # (jsan host-sync review, PR 3)
+        vals = [float(v) for v in np.asarray(fitness)]
         if vals and not any(math.isfinite(v) for v in vals):
             return f"all {len(vals)} members non-finite (fitness={vals})"
         return None
