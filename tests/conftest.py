@@ -69,17 +69,24 @@ def pytest_collection_modifyitems(config, items):
 
 @pytest.fixture(autouse=True)
 def _sanitize(request):
-    """Enable the JAX sanitizers for tests marked ``sanitize``."""
+    """Enable the JAX sanitizers for tests marked ``sanitize``:
+    jax_enable_checks + jax_debug_nans (the original pair), plus
+    jax_numpy_rank_promotion="raise" (PR 3): an implicit [E] vs [T, E]
+    broadcast in an obs builder or loss silently trains on wrong data —
+    raising turns the silent wrong-math class into a test failure."""
     if request.node.get_closest_marker("sanitize") is None:
         yield
         return
     import jax
     prev_checks = jax.config.jax_enable_checks
     prev_nans = jax.config.jax_debug_nans
+    prev_rank = jax.config.jax_numpy_rank_promotion
     jax.config.update("jax_enable_checks", True)
     jax.config.update("jax_debug_nans", True)
+    jax.config.update("jax_numpy_rank_promotion", "raise")
     try:
         yield
     finally:
         jax.config.update("jax_enable_checks", prev_checks)
         jax.config.update("jax_debug_nans", prev_nans)
+        jax.config.update("jax_numpy_rank_promotion", prev_rank)

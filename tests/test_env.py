@@ -8,8 +8,8 @@ import jax.numpy as jnp
 
 from rlgpuschedule_tpu.env import (EnvParams, reset, step, auto_reset_step,
                                    stack_traces, vec_reset, vec_step,
-                                   build_adjacency, reward_jct, tenant_counts)
-from rlgpuschedule_tpu.sim.core import SimParams, Trace, StepInfo
+                                   build_adjacency)
+from rlgpuschedule_tpu.sim.core import SimParams, Trace
 from rlgpuschedule_tpu.traces import gen_poisson_trace, to_array_trace, JobRecord
 
 
@@ -28,6 +28,11 @@ def make_trace(seed=0, n_jobs=12, max_jobs=16):
 
 
 class TestResetStep:
+    # sanitize: all three obs builders under jax_enable_checks +
+    # debug_nans + rank_promotion="raise" (PR 3) — an implicit [K] vs
+    # [K, 1] broadcast in queue/run features would silently mis-shape
+    # the training signal; raising makes it a failure here
+    @pytest.mark.sanitize
     @pytest.mark.parametrize("obs_kind", ["flat", "grid", "graph"])
     def test_obs_shapes_and_dtypes(self, obs_kind):
         params = make_params(obs_kind)
@@ -210,6 +215,7 @@ class TestAutoReset:
 
 
 class TestVectorized:
+    @pytest.mark.sanitize   # vmapped reset/step under the strict config
     def test_vec_env_batch(self):
         params = make_params()
         traces = stack_traces([gen_poisson_trace(0.05, 10, seed=s, max_jobs=16,

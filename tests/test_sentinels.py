@@ -1,0 +1,151 @@
+"""Runtime sentinel tests (PR 3): the compile-count monitor and the
+transfer guard, plus the recompile-regression gate that protects PR 2's
+fused update engine from silent cache-miss regressions.
+
+The regression this gate exists for: a change that makes the jitted
+update step re-trace per call (shape-unstable argument, rebuilt function
+object, unhashable static capture) slows training by the full compile
+time per iteration while every numeric test still passes. The bench
+would eventually notice; this makes it a test failure instead.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from rlgpuschedule_tpu.algos.update import (make_update_step,
+                                            run_minibatch_epochs)
+from rlgpuschedule_tpu.analysis.sentinels import (CompileCounter,
+                                                  RecompileSentinelError,
+                                                  assert_no_recompiles,
+                                                  no_implicit_transfers)
+
+
+def _make_problem(batch=32, dim=8, seed=0):
+    """Tiny linear-regression state + batch for the fused engine."""
+    rng = np.random.default_rng(seed)
+    params = {"w": jnp.asarray(rng.normal(size=(dim,)).astype(np.float32)),
+              "b": jnp.float32(0.0)}
+    tx = optax.sgd(1e-2)
+    state = (params, tx.init(params))
+    x = jnp.asarray(rng.normal(size=(batch, dim)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(batch,)).astype(np.float32))
+
+    def grad_step(state, mb):
+        params, opt_state = state
+        xb, yb = mb
+
+        def loss_fn(p):
+            pred = xb @ p["w"] + p["b"]
+            return jnp.mean((pred - yb) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state), loss
+
+    return grad_step, state, (x, y)
+
+
+class TestCompileCounter:
+    def test_counts_a_fresh_compile(self):
+        with CompileCounter() as c:
+            # a never-before-seen shape forces trace + compile
+            jax.jit(lambda v: v * 3 + 1)(jnp.ones((7, 13, 3))) \
+                .block_until_ready()
+        assert c.traces >= 1
+        assert c.backend_compiles + c.traces == c.total
+        assert c.total >= 1
+
+    def test_listener_detaches_on_exit(self):
+        with CompileCounter() as c:
+            pass
+        before = c.total
+        jax.jit(lambda v: v - 2)(jnp.ones((5, 11))).block_until_ready()
+        assert c.total == before   # no counting outside the context
+
+    def test_assert_no_recompiles_raises_and_names_the_cause(self):
+        with pytest.raises(RecompileSentinelError, match="recompiling"):
+            with assert_no_recompiles("fresh-shape region"):
+                jax.jit(lambda v: v + 5)(jnp.ones((3, 17, 9)))
+
+
+class TestTransferGuard:
+    def test_implicit_transfer_raises_inside_guard(self):
+        # mixing a host numpy array into device math is an implicit
+        # host->device transfer — the hidden-upload class the guard
+        # exists for. (On the CPU backend device->host reads are
+        # zero-copy and unguarded, so host->device is the observable
+        # direction in CI; on a TPU both directions trip it.)
+        dev = jnp.arange(8.0)
+        host = np.ones(8, np.float32)
+        with pytest.raises(Exception, match="[Dd]isallow"):
+            with no_implicit_transfers():
+                _ = (dev + host).block_until_ready()
+
+    def test_explicit_transfers_stay_legal(self):
+        dev = jnp.arange(8.0)
+        with no_implicit_transfers():
+            host = jax.device_get(dev)          # explicit: allowed
+            dev2 = jax.device_put(host)         # explicit: allowed
+        assert float(np.asarray(dev2)[3]) == 3.0
+
+
+class TestUpdateStepCompilesOnce:
+    """The acceptance gate: N train iterations through make_update_step
+    at fixed geometry trigger exactly one compilation — iterations 2..N
+    reuse the cached executable, device-resident end to end.
+
+    sanitize-marked (NOT perf): no timing asserts, so CI load can't
+    flake it, and running under jax_enable_checks + debug_nans +
+    rank_promotion="raise" proves the sentinel composes with the strict
+    interpreter the sanitize tier runs."""
+
+    @pytest.mark.sanitize
+    def test_geometry_stable_iterations_compile_once(self):
+        grad_step, state, data = _make_problem()
+
+        def run_update(state, data, key):
+            return run_minibatch_epochs(grad_step, state, data, key,
+                                        n_epochs=2, n_minibatches=4)
+
+        step = make_update_step(run_update)   # donates the state
+        # precompute per-iteration keys OUTSIDE the counted region —
+        # jax.random.split dispatches its own tiny programs
+        keys = list(jax.random.split(jax.random.PRNGKey(0), 6))
+
+        with CompileCounter() as warm:
+            state, _ = step(state, data, keys[0])
+            jax.block_until_ready(state)
+        assert warm.traces >= 1   # the one allowed compilation
+
+        # steady state: same geometry, fresh keys, donated state threads
+        # through; zero traces, zero backend compiles, zero implicit
+        # transfers
+        with assert_no_recompiles("geometry-stable update step"):
+            with no_implicit_transfers():
+                for k in keys[1:]:
+                    state, _ = step(state, data, k)
+        jax.block_until_ready(state)
+
+    @pytest.mark.sanitize
+    def test_geometry_change_recompiles_once_then_caches(self):
+        """Control for the gate above: a DIFFERENT geometry must compile
+        (proves the counter actually sees this program class), and
+        returning to it again must not."""
+        grad_step, state, data = _make_problem(batch=48)
+
+        def run_update(state, data, key):
+            return run_minibatch_epochs(grad_step, state, data, key,
+                                        n_epochs=1, n_minibatches=3)
+
+        step = make_update_step(run_update)
+        keys = list(jax.random.split(jax.random.PRNGKey(1), 3))
+        with CompileCounter() as first:
+            state, _ = step(state, data, keys[0])
+            jax.block_until_ready(state)
+        assert first.traces >= 1
+        with assert_no_recompiles("repeat of a cached geometry"):
+            for k in keys[1:]:
+                state, _ = step(state, data, k)
+        jax.block_until_ready(state)
