@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# CI pipeline (PR 3): lint stage, then the tier-1 pytest gate.
+#
+# Stage 1 — lint (fast, no JAX import for jsan's AST pass):
+#   1a. jsan: the repo's JAX-pitfall static analyzer. Scope is the
+#       package + the top-level entry scripts. tests/ is NOT scanned:
+#       single-shot jit(lambda) in a test body is benign (each test
+#       compiles once by design) and tests/fixtures/ holds jsan's own
+#       deliberately-bad corpus. Baseline: jsan_baseline.json.
+#   1b. ruff + mypy at the pyproject.toml config, pinned there
+#       (ruff==0.6.9, mypy==1.11.2). Both gate on availability: the
+#       hermetic CI image does not ship them, and the lint stage must
+#       not mutate the environment by installing things — when absent
+#       they are SKIPPED LOUDLY, not failed.
+#
+# Stage 2 — the tier-1 gate, verbatim from ROADMAP.md.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "=== lint 1/3: jsan (python -m rlgpuschedule_tpu.analysis) ==="
+python -m rlgpuschedule_tpu.analysis \
+    rlgpuschedule_tpu bench.py __graft_entry__.py \
+    --baseline jsan_baseline.json
+
+echo "=== lint 2/3: ruff ==="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check rlgpuschedule_tpu tests
+else
+    echo "SKIP: ruff not installed (pinned ruff==0.6.9 in pyproject.toml)"
+fi
+
+echo "=== lint 3/3: mypy ==="
+if command -v mypy >/dev/null 2>&1; then
+    mypy
+else
+    echo "SKIP: mypy not installed (pinned mypy==1.11.2 in pyproject.toml)"
+fi
+
+echo "=== tier-1 pytest gate (ROADMAP.md) ==="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+exit $rc

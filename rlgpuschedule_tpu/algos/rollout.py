@@ -13,10 +13,9 @@ from __future__ import annotations
 from typing import Any, Callable, NamedTuple
 
 import jax
-import jax.numpy as jnp
 
 from ..env import env as env_lib
-from ..env.env import EnvParams, EnvState, TimeStep
+from ..env.env import EnvParams, EnvState
 from . import action_dist
 
 # (net_params, obs, mask) -> (masked_logits, value[E]). obs/mask/logits may

@@ -18,7 +18,6 @@ from typing import Any, Literal, NamedTuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..sim import core
 from ..sim.core import SimParams, SimState, Trace, StepInfo

@@ -31,7 +31,7 @@ only. Global metrics (JCT, done) therefore reduce over the pod axis:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, NamedTuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

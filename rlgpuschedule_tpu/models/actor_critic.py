@@ -7,8 +7,6 @@ step 4 "action masking via -inf logits").
 """
 from __future__ import annotations
 
-from typing import Callable
-
 import jax
 import jax.numpy as jnp
 import flax.linen as nn

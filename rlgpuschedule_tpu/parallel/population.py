@@ -19,7 +19,6 @@ step serves every member. The learning rate is applied manually after
 """
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Callable, NamedTuple
 
 import jax

@@ -9,7 +9,7 @@ simulator needs static shapes (SURVEY.md §7 step 1).
 from __future__ import annotations
 
 import dataclasses
-from typing import Iterable, Sequence
+from typing import Sequence
 
 import numpy as np
 

@@ -2,7 +2,6 @@
 "Distributed without a real cluster"): sharded train step runs, params stay
 replicated-identical, and DP matches single-device training bit-for-bit
 given the same global batch."""
-import dataclasses
 
 import numpy as np
 import pytest

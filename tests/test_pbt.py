@@ -12,7 +12,7 @@ from rlgpuschedule_tpu.algos import (PPOConfig, init_carry, make_ppo_step,
                                      make_train_state)
 from rlgpuschedule_tpu.algos.ppo import make_optimizer
 from rlgpuschedule_tpu.configs import PPO_MLP_SYNTH64
-from rlgpuschedule_tpu.experiment import (Experiment, PopulationExperiment,
+from rlgpuschedule_tpu.experiment import (PopulationExperiment,
                                           build_env_params,
                                           load_source_trace,
                                           make_env_windows)
@@ -21,8 +21,7 @@ from rlgpuschedule_tpu.models import make_policy
 from rlgpuschedule_tpu.parallel import (HParams, PBTConfig, PBTController,
                                         exploit_explore, gather_members,
                                         init_member, make_member_step,
-                                        make_mesh, sample_hparams,
-                                        stack_members)
+                                        make_mesh, sample_hparams)
 
 TINY = dataclasses.replace(
     PPO_MLP_SYNTH64, n_nodes=2, gpus_per_node=4, n_envs=4, window_jobs=16,

@@ -15,7 +15,6 @@ real processes); this file covers everything in-process.
 """
 import dataclasses
 import math
-import os
 import time
 
 import jax
