@@ -13,7 +13,14 @@
 #       not mutate the environment by installing things — when absent
 #       they are SKIPPED LOUDLY, not failed.
 #
-# Stage 2 — the tier-1 gate, verbatim from ROADMAP.md.
+# Stage 2 — the tier-1 gate (ROADMAP.md), split in two: the main pass
+#   excludes the multihost_spawn subset, which then runs SERIALLY after
+#   it. The spawn tests fork real jax.distributed gangs whose gloo
+#   collective rendezvous (~30s window) races per-rank XLA compile —
+#   on a small rig, running them next to the rest of the suite's CPU
+#   load is the reproducible way to flake them. The ROADMAP one-liner
+#   (everything in one pass) stays the driver's acceptance command;
+#   this split is strictly more conservative.
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -36,13 +43,24 @@ else
     echo "SKIP: mypy not installed (pinned mypy==1.11.2 in pyproject.toml)"
 fi
 
-echo "=== tier-1 pytest gate (ROADMAP.md) ==="
+echo "=== tier-1 pytest gate 1/2: main pass (ROADMAP.md, minus spawn) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
-    python -m pytest tests/ -q -m 'not slow' \
+    python -m pytest tests/ -q -m 'not slow and not multihost_spawn' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
     -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
     | tr -cd . | wc -c)
+[ "$rc" -eq 0 ] || exit $rc
+
+echo "=== tier-1 pytest gate 2/2: multihost spawn subset (serial) ==="
+rm -f /tmp/_t1_spawn.log
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow and multihost_spawn' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1_spawn.log
+rc=${PIPESTATUS[0]}
+echo SPAWN_DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' \
+    /tmp/_t1_spawn.log | tr -cd . | wc -c)
 exit $rc

@@ -13,11 +13,27 @@ from a live template state, so mesh-placed params round-trip onto the
 same mesh layout without a host gather.
 
 Layout per step: ``state/`` (params, opt_state, step, key — arrays) +
-``meta/`` (JSON scalars: hyperparams, fitness — what PBT reads/writes).
+``meta/`` (JSON scalars: hyperparams, fitness — what PBT reads/writes),
+plus a ``.crc/<step>.json`` sidecar (crc32 per payload file) so restore
+can reject a torn/truncated step with a cheap read instead of a full
+failed deserialization.
+
+Elastic recovery (shrink-to-fit): :meth:`Checkpointer.elastic_restore`
+restores a checkpoint written at world size N onto a SMALLER surviving
+topology — replicated state (params, optimizer moments) is world-size
+independent and restores unchanged; env-batched ``extra`` payloads (the
+rollout carry) keep only the surviving data shards' row blocks
+(``parallel.dp.shrink_env_rows``); and the update geometry is
+re-validated against the shrunk global batch up front
+(:func:`validate_shrunk_geometry`), so an untileable shrink fails with
+a clear error instead of a shape error mid-step.
 """
 from __future__ import annotations
 
+import json
+import os
 import sys
+import zlib
 from typing import Any
 
 import jax
@@ -29,6 +45,82 @@ from flax.training.train_state import TrainState
 class CheckpointRestoreError(RuntimeError):
     """Every retained checkpoint step failed to restore (corruption /
     truncation across the whole rotation window)."""
+
+
+class CheckpointChecksumError(RuntimeError):
+    """A step's crc32 sidecar disagrees with its on-disk payload (torn
+    write / truncation, caught by the cheap pre-check)."""
+
+
+class ElasticRestoreError(RuntimeError):
+    """A shrink-to-fit restore cannot produce a runnable configuration at
+    the surviving world size (untileable update geometry / batch)."""
+
+
+def _sidecar_path(directory: str, step: int) -> str:
+    # outside the step dir: Orbax owns the step dir's contents, and a
+    # foreign file inside it would be deleted with the step anyway —
+    # .crc/ is pruned by Checkpointer.wait() instead
+    return os.path.join(directory, ".crc", f"{step}.json")
+
+
+def _step_payload_files(directory: str, step: int) -> list[str]:
+    """Every file of checkpoint ``step``, as step-dir-relative paths
+    (sorted for a stable sidecar)."""
+    step_dir = os.path.join(directory, str(step))
+    out = []
+    for root, _dirs, files in os.walk(step_dir):
+        for f in files:
+            out.append(os.path.relpath(os.path.join(root, f), step_dir))
+    return sorted(out)
+
+
+def _crc32_file(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc
+
+
+def write_checksum_sidecar(directory: str, step: int) -> dict[str, int]:
+    """(Re)compute ``{relpath: crc32}`` over checkpoint ``step``'s files
+    and atomically write the ``.crc/<step>.json`` sidecar. Called by
+    :meth:`Checkpointer.wait` once a save is durable (checksumming an
+    in-flight async save would record a torn view — exactly what the
+    sidecar exists to catch)."""
+    sums = {rel: _crc32_file(os.path.join(directory, str(step), rel))
+            for rel in _step_payload_files(directory, step)}
+    path = _sidecar_path(directory, step)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(sums, f)
+    os.replace(tmp, path)
+    return sums
+
+
+def validate_shrunk_geometry(n_epochs: int, n_minibatches: int,
+                             minibatch_size: int | None, n_steps: int,
+                             n_envs: int, old_n_envs: int | None = None
+                             ) -> tuple[int, int, int]:
+    """Re-validate the update geometry against a SHRUNK global batch
+    (``n_steps × n_envs``), translating the tiling failure into
+    :class:`ElasticRestoreError` with the shrink named — the fail-fast
+    gate a shrink-to-fit restart runs BEFORE compiling anything, so an
+    untileable surviving world dies with a clear error instead of a
+    shape error mid-step. Returns the resolved geometry triple."""
+    from rlgpuschedule_tpu.algos.update import resolve_geometry
+    try:
+        return resolve_geometry(n_epochs, n_minibatches, minibatch_size,
+                                n_steps * n_envs)
+    except ValueError as e:
+        was = (f" (was {n_steps * old_n_envs} before the shrink)"
+               if old_n_envs is not None else "")
+        raise ElasticRestoreError(
+            f"shrink-to-fit: surviving global batch n_steps*n_envs = "
+            f"{n_steps}*{n_envs} = {n_steps * n_envs}{was} does not tile "
+            f"the update geometry: {e}") from e
 
 
 # module-level jit: a fresh `jax.jit(lambda ...)` per restore would defeat
@@ -109,11 +201,15 @@ class Checkpointer:
             # an in-flight async save of the same step is invisible to
             # all_steps() until finalized — settle it first so force can't
             # silently degrade to a skipped save
-            self._mngr.wait_until_finished()
+            self.wait()
             if step in self._mngr.all_steps():
                 # Orbax refuses duplicate steps outright (its ``force`` only
                 # bypasses save-interval policy); overwrite = delete + save
                 self._mngr.delete(step)
+                try:
+                    os.unlink(_sidecar_path(self.directory, step))
+                except FileNotFoundError:
+                    pass   # step predates the sidecar scheme
         try:
             saved = self._mngr.save(
                 step,
@@ -124,7 +220,7 @@ class Checkpointer:
         except ocp.checkpoint_manager.StepAlreadyExistsError:
             return False
         if force:
-            self._mngr.wait_until_finished()
+            self.wait()
         return bool(saved)
 
     def restore(self, template_state: TrainState,
@@ -149,41 +245,13 @@ class Checkpointer:
 
         The returned arrays live in fresh buffers (see :func:`_fresh_copy`)
         so callers may hand them straight to a donating jitted step."""
-        if step is not None:
-            candidates = [step]
-        else:
-            candidates = sorted(self._mngr.all_steps(), reverse=True)
-        if not candidates:
-            raise FileNotFoundError(
-                f"no checkpoint found under {self.directory}")
         template = _state_tree(template_state, template_key, template_extra)
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-        restored = None
-        errors: list[tuple[int, Exception]] = []
-        for i, s in enumerate(candidates):
-            try:
-                restored = self._mngr.restore(
-                    s,
-                    args=ocp.args.Composite(
-                        state=ocp.args.StandardRestore(abstract),
-                        meta=ocp.args.JsonRestore()))
-                self.last_restored_step = s
-                break
-            except Exception as e:   # orbax surfaces corruption as
-                errors.append((s, e))  # assorted exception types
-                if step is not None or not fallback:
-                    raise
-                if i + 1 < len(candidates):
-                    print(f"checkpoint: step {s} failed to restore "
-                          f"({type(e).__name__}: {str(e)[:200]}); "
-                          f"falling back to step {candidates[i + 1]}",
-                          file=sys.stderr, flush=True)
-        if restored is None:
-            raise CheckpointRestoreError(
-                f"all {len(candidates)} retained checkpoint steps under "
-                f"{self.directory} failed to restore: "
-                + "; ".join(f"step {s}: {type(e).__name__}"
-                            for s, e in errors)) from errors[-1][1]
+        restored = self._restore_candidates(
+            step, fallback,
+            lambda: ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract),
+                meta=ocp.args.JsonRestore()))
         tree = _fresh_copy(restored["state"])
         # TrainState is a flax struct (.replace); population MemberState is
         # a NamedTuple (._replace) — both checkpoint through the same path
@@ -193,6 +261,149 @@ class Checkpointer:
                     opt_state=tree["opt_state"])
         return state, tree.get("key"), tree.get("extra"), dict(
             restored["meta"] or {})
+
+    def _restore_candidates(self, step: int | None, fallback: bool,
+                            build_args) -> Any:
+        """The integrity-fallback candidate loop shared by
+        :meth:`restore` and :meth:`elastic_restore`: newest retained step
+        first, each pre-checked against its crc32 sidecar (a mismatch is
+        rejected for the price of a re-read instead of a full failed
+        deserialization), falling back on any failure until a step
+        restores or every candidate is exhausted."""
+        if step is not None:
+            candidates = [step]
+        else:
+            candidates = sorted(self._mngr.all_steps(), reverse=True)
+        if not candidates:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        errors: list[tuple[int, Exception]] = []
+        for i, s in enumerate(candidates):
+            try:
+                self._verify_checksums(s)
+                restored = self._mngr.restore(s, args=build_args())
+                self.last_restored_step = s
+                return restored
+            except Exception as e:   # orbax surfaces corruption as
+                errors.append((s, e))  # assorted exception types
+                if step is not None or not fallback:
+                    raise
+                if i + 1 < len(candidates):
+                    print(f"checkpoint: step {s} failed to restore "
+                          f"({type(e).__name__}: {str(e)[:200]}); "
+                          f"falling back to step {candidates[i + 1]}",
+                          file=sys.stderr, flush=True)
+        raise CheckpointRestoreError(
+            f"all {len(candidates)} retained checkpoint steps under "
+            f"{self.directory} failed to restore: "
+            + "; ".join(f"step {s}: {type(e).__name__}"
+                        for s, e in errors)) from errors[-1][1]
+
+    def _verify_checksums(self, step: int) -> None:
+        """Cheap integrity pre-check: compare checkpoint ``step``'s files
+        against its crc32 sidecar. A step with no sidecar (crashed before
+        ``wait()``, or pre-sidecar checkpoints) passes — the deep
+        restore-failure fallback still covers it."""
+        path = _sidecar_path(self.directory, step)
+        try:
+            with open(path) as f:
+                expected = json.load(f)
+        except FileNotFoundError:
+            return
+        for rel, crc in expected.items():
+            full = os.path.join(self.directory, str(step), rel)
+            try:
+                actual = _crc32_file(full)
+            except FileNotFoundError as e:
+                raise CheckpointChecksumError(
+                    f"checkpoint step {step}: payload file {rel} named in "
+                    f"the checksum sidecar is missing") from e
+            if actual != crc:
+                raise CheckpointChecksumError(
+                    f"checkpoint step {step}: crc32 mismatch on {rel} "
+                    f"(sidecar {crc:#010x}, on disk {actual:#010x})")
+
+    def elastic_restore(self, template_state: TrainState, *,
+                        old_world: int, surviving_ranks,
+                        old_n_envs: int | None = None, mesh=None,
+                        geometry: tuple[int, int, int | None, int]
+                        | None = None,
+                        step: int | None = None, fallback: bool = True,
+                        ) -> tuple[TrainState, jax.Array | None, Any, dict]:
+        """Shrink-to-fit restore: load a checkpoint written when the data
+        axis had ``old_world`` shards onto the smaller surviving topology.
+
+        - ``params``/``opt_state``/``step`` are replicated state — world-
+          size independent, restored unchanged (template-FREE restore:
+          the saved shapes are authoritative, not a template built at
+          either world size).
+        - env-batched ``extra`` leaves (leading dim ``old_n_envs``,
+          inferred from the first extra leaf when not given) keep only
+          ``surviving_ranks``' contiguous row blocks
+          (``parallel.dp.shrink_env_rows``).
+        - ``geometry`` = ``(n_epochs, n_minibatches, minibatch_size,
+          n_steps)``, when given, is re-validated against the shrunk
+          global batch via :func:`validate_shrunk_geometry` — the
+          fail-fast on untileable shrink.
+        - ``mesh``, when given, is the NEW (surviving) mesh: the state is
+          placed replicated on it and the shrunk env batch is checked to
+          divide its data axis. The extra tree is returned HOST-side
+          (numpy): env-batched and non-batched leaves need different
+          placements, which the caller owns (``dp.put_carry``).
+
+        ``template_state`` supplies only the treedef/``replace``; its
+        values and shardings are ignored. Same integrity fallback as
+        :meth:`restore`. Returns ``(state, key, extra, meta)``."""
+        import numpy as np
+
+        surv = sorted(set(int(r) for r in surviving_ranks))
+        restored = self._restore_candidates(
+            step, fallback,
+            lambda: ocp.args.Composite(
+                state=ocp.args.StandardRestore(),
+                meta=ocp.args.JsonRestore()))
+        # host-side copies, not the jitted `_fresh_copy`: a template-free
+        # restore brings leaves back under their SAVED shardings (old
+        # mesh), which no single jit can consume alongside unsharded
+        # leaves — and the old topology may not even exist anymore. The
+        # numpy round-trip both decouples from orbax's buffers (the
+        # donation hazard `_fresh_copy` exists for) and frees the state
+        # from the dead world's layout; a restart path can afford it.
+        tree = jax.tree.map(np.asarray, restored["state"])
+        from rlgpuschedule_tpu.parallel import dp
+        extra = tree.get("extra")
+        new_n_envs = None
+        leaves = jax.tree.leaves(extra) if extra is not None else []
+        if leaves:
+            if old_n_envs is None:
+                old_n_envs = int(leaves[0].shape[0])
+            if old_n_envs % old_world:
+                raise ElasticRestoreError(
+                    f"saved env batch {old_n_envs} does not tile the "
+                    f"saved world's {old_world} data shards — cannot "
+                    f"attribute rows to surviving ranks")
+            new_n_envs = old_n_envs // old_world * len(surv)
+            if geometry is not None:
+                n_epochs, n_mb, mb_size, n_steps = geometry
+                validate_shrunk_geometry(n_epochs, n_mb, mb_size, n_steps,
+                                         new_n_envs, old_n_envs)
+            extra = dp.shrink_env_rows(
+                extra, old_n_envs=old_n_envs, old_world=old_world,
+                surviving_ranks=surv)
+        rep = getattr(template_state, "replace", None) or \
+            template_state._replace
+        state = rep(step=tree["step"], params=tree["params"],
+                    opt_state=tree["opt_state"])
+        if mesh is not None:
+            from rlgpuschedule_tpu.parallel.mesh import (DATA_AXIS,
+                                                         replicated)
+            n_data = mesh.shape[DATA_AXIS]
+            if new_n_envs is not None and new_n_envs % n_data:
+                raise ElasticRestoreError(
+                    f"shrunk env batch {new_n_envs} not divisible by the "
+                    f"surviving mesh's data axis ({n_data})")
+            state = dp.put_global(state, replicated(mesh))
+        return state, tree.get("key"), extra, dict(restored["meta"] or {})
 
     def read_meta(self, step: int | None = None) -> dict:
         """Read a checkpoint's JSON meta without restoring its arrays
@@ -207,11 +418,26 @@ class Checkpointer:
 
     def wait(self) -> None:
         """Block until async saves are durable (call before reading the
-        files from another process, e.g. a PBT exploit copy)."""
+        files from another process, e.g. a PBT exploit copy), then settle
+        the crc32 sidecars: write one for every retained step that lacks
+        it (checksumming an in-flight save would record a torn view, so
+        sidecars land here, not in ``save``) and prune sidecars whose
+        step was rotated out."""
         self._mngr.wait_until_finished()
+        steps = set(self._mngr.all_steps())
+        for s in steps:
+            if not os.path.exists(_sidecar_path(self.directory, s)):
+                write_checksum_sidecar(self.directory, s)
+        crc_dir = os.path.join(self.directory, ".crc")
+        if os.path.isdir(crc_dir):
+            for name in os.listdir(crc_dir):
+                stem = name.partition(".")[0]
+                if name.endswith(".json") and stem.isdigit() \
+                        and int(stem) not in steps:
+                    os.unlink(os.path.join(crc_dir, name))
 
     def close(self) -> None:
-        self._mngr.wait_until_finished()
+        self.wait()
         self._mngr.close()
 
     def __enter__(self) -> "Checkpointer":

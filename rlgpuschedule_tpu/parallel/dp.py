@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..algos.rollout import RolloutCarry
-from .mesh import DATA_AXIS, env_sharded, replicated
+from .mesh import DATA_AXIS, data_shard_slices, env_sharded, replicated
 
 
 def shard_map_compat(fn, mesh, in_specs, out_specs, check: bool = True):
@@ -93,6 +93,41 @@ def put_carry(mesh: Mesh, carry: RolloutCarry,
         obs=put_global(carry.obs, env),
         mask=put_global(carry.mask, env),
         key=put_global(carry.key, key_sharding or replicated(mesh)))
+
+
+def shrink_env_rows(tree: Any, *, old_n_envs: int, old_world: int,
+                    surviving_ranks) -> Any:
+    """Shrink-to-fit an env-batched pytree to the surviving data shards:
+    every leaf whose leading dim is ``old_n_envs`` keeps ONLY the row
+    blocks that lived on ``surviving_ranks`` (contiguous per-shard blocks
+    under ``env_sharded``'s layout — ``mesh.data_shard_slices``); leaves
+    with any other leading dim (replicated params, PRNG keys, scalars)
+    pass through untouched. Host-side numpy op: the shrunk tree is
+    re-placed on the new mesh by the caller (``put_global``/``put_carry``
+    accept any world size — that is the elastic contract).
+
+    Caveat: "env-batched" is recognized by leading-dim equality, so an
+    ``old_n_envs`` that collides with an unrelated leaf's leading dim
+    (e.g. 2, a raw PRNG key's length) would mis-slice it — callers keep
+    key leaves out of the tree or use batches > 2 (every real config
+    does)."""
+    import numpy as np
+
+    surv = sorted(set(int(r) for r in surviving_ranks))
+    if not surv:
+        raise ValueError("shrink_env_rows: no surviving ranks")
+    if surv[0] < 0 or surv[-1] >= old_world:
+        raise ValueError(f"surviving_ranks {surv} outside the saved world "
+                         f"range [0, {old_world})")
+    slices = data_shard_slices(old_n_envs, old_world)
+
+    def shrink(x):
+        arr = np.asarray(x)
+        if arr.ndim >= 1 and arr.shape[0] == old_n_envs:
+            return np.concatenate([arr[slices[r]] for r in surv], axis=0)
+        return arr
+
+    return jax.tree.map(shrink, tree)
 
 
 def _check_env_divisible(mesh: Mesh, traces) -> None:

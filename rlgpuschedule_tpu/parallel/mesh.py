@@ -53,3 +53,20 @@ def pop_sharded(mesh: Mesh) -> NamedSharding:
 def pop_env_sharded(mesh: Mesh) -> NamedSharding:
     """[pop, env, ...] arrays: population × env-batch."""
     return NamedSharding(mesh, P(POP_AXIS, DATA_AXIS))
+
+
+def data_shard_slices(n_rows: int, n_shards: int) -> list[slice]:
+    """The contiguous row block each of ``n_shards`` equal data shards
+    owns in a ``[n_rows, ...]`` env-batched array under ``env_sharded``'s
+    layout (shard r ↦ rows ``[r*per, (r+1)*per)``). This is the mapping
+    elastic recovery relies on to identify which rows SURVIVE when a
+    shard's host is lost, so it lives here next to the sharding it
+    mirrors. Raises on a ragged split — the same tileability contract
+    ``dp.shard_train`` enforces."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    if n_rows % n_shards:
+        raise ValueError(f"n_rows={n_rows} not divisible by "
+                         f"n_shards={n_shards}")
+    per = n_rows // n_shards
+    return [slice(r * per, (r + 1) * per) for r in range(n_shards)]

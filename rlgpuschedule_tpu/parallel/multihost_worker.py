@@ -35,13 +35,30 @@ Resilience surface (the supervised dryrun drives all of it):
   from step S (the supervisor passes the minimum completed step across
   ranks; a rank that durably got further must restore the OLDER state,
   or the gang resumes from divergent replicated params).
-- ``--fault kill-rank@T:rank=R`` — rank R dies un-gracefully right
-  before step T, i.e. before entering the step's collective, so every
-  rank's last durable checkpoint is step T-1 or later.
+- ``--restore-rank R`` — restore RANK R's checkpoint file instead of
+  this rank's own (default). This is the shrink-to-fit hook: after a
+  permanent rank loss the supervisor relaunches the gang at the
+  surviving world size, and new rank i restores surviving old rank
+  ``restore_ranks[i]``'s file. Sound because the persisted state is
+  replicated (params + optimizer moments) — every rank's file at step S
+  holds the same state, so any surviving rank's copy re-seeds the
+  shrunk gang at ANY world size (``--num-procs`` is free to differ from
+  the world the checkpoint was written at; the update geometry is
+  re-validated against the shrunk global batch before anything
+  compiles).
+- ``--fault kill-rank@T:rank=R | lose-rank@T:rank=R`` — rank R dies
+  un-gracefully right before step T, i.e. before entering the step's
+  collective, so every rank's last durable checkpoint is step T-1 or
+  later. ``kill-rank`` exits restartable (``faults.KILL_RANK_EXIT``);
+  ``lose-rank`` exits ``faults.LOSE_RANK_EXIT``, the permanent-loss
+  signature the supervisor answers with a shrink instead of a respawn.
 
 Per-step rollout keys are ``PRNGKey(i)`` — a restarted rank replays the
 same key sequence from its resume step, so all ranks (including the
-respawned one) converge to identical fingerprints.
+respawned one) converge to identical fingerprints; a SHRUNK gang runs a
+smaller env batch (fewer global devices), so its fingerprints differ
+from the old world's, but they must still AGREE across the surviving
+ranks — the cross-rank contract holds at every world size.
 """
 from __future__ import annotations
 
@@ -107,8 +124,14 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--resume-step", type=int, default=-1,
                     help=">= 0: restore rank<r>.npz from --ckpt-dir and "
                          "continue from this step")
+    ap.add_argument("--restore-rank", type=int, default=-1,
+                    help=">= 0: with --resume-step, restore THIS rank's "
+                         "checkpoint file instead of our own (shrink-to-"
+                         "fit: a surviving old rank's replicated state "
+                         "re-seeds the shrunk gang)")
     ap.add_argument("--fault", action="append", default=None,
-                    help="kill-rank@T:rank=R (resilience.parse_fault)")
+                    help="kill-rank@T:rank=R | lose-rank@T:rank=R "
+                         "(resilience.parse_fault)")
     ap.add_argument("--no-pbt-check", action="store_true",
                     help="skip the PBT exploit-gather section (the "
                          "supervised dryrun tests recovery, not PBT)")
@@ -174,6 +197,21 @@ def main(argv: list[str] | None = None) -> None:
     # ---- DP across processes (config-1 shape, tiny) ----------------------
     mesh = multihost.global_mesh()
     n_envs = 2 * n_global
+    cfg = PPOConfig(n_steps=8, n_epochs=1, n_minibatches=2)
+    # elastic fail-fast: the world size may differ from the one the
+    # checkpoint was written at (shrink-to-fit relaunch) — re-validate
+    # the update geometry against THIS world's global batch before any
+    # mesh/compile work, so an untileable shrink dies with a clear error
+    # instead of a shape error mid-step
+    from rlgpuschedule_tpu.algos import resolve_geometry
+    try:
+        resolve_geometry(cfg.n_epochs, cfg.n_minibatches,
+                         cfg.minibatch_size, cfg.n_steps * n_envs)
+    except ValueError as e:
+        raise SystemExit(
+            f"elastic geometry: world size {args.num_procs} "
+            f"({n_global} devices, global batch {cfg.n_steps}x{n_envs}) "
+            f"does not tile the update geometry: {e}") from e
     env_params = EnvParams(
         sim=SimParams(n_nodes=4, gpus_per_node=4, max_jobs=12, queue_len=4),
         obs_kind="flat", horizon=32, time_scale=60.0, reward_scale=100.0)
@@ -190,7 +228,6 @@ def main(argv: list[str] | None = None) -> None:
 
     net = make_policy("flat", env_params.n_actions)
     apply_fn = lambda p, o, m: net.apply(p, o, m)
-    cfg = PPOConfig(n_steps=8, n_epochs=1, n_minibatches=2)
     # distinct streams for the rollout carry and the param init (jsan
     # prng-key-reuse, PR 3 first-run finding: the same PRNGKey(0) fed the
     # carry, the global carry assembly, AND net.init — action sampling
@@ -215,13 +252,14 @@ def main(argv: list[str] | None = None) -> None:
     start = 0
     if args.ckpt_dir and args.resume_step >= 0:
         start = args.resume_step
-        state = _load_rank_ckpt(args.ckpt_dir, args.proc_id, state, start)
-        print(f"MULTIHOST_RESUMED proc={args.proc_id} step={start}",
-              flush=True)
+        src = args.restore_rank if args.restore_rank >= 0 else args.proc_id
+        state = _load_rank_ckpt(args.ckpt_dir, src, state, start)
+        print(f"MULTIHOST_RESUMED proc={args.proc_id} step={start} "
+              f"from_rank={src}", flush=True)
     step, state, carry, traces = dp.shard_train(
         mesh, make_ppo_step(apply_fn, env_params, cfg), state, carry, traces)
     for i in range(start, args.steps):
-        injector.maybe_kill_rank(args.proc_id, i)
+        injector.maybe_exit_rank(args.proc_id, i)
         if hb is not None:
             hb.beat(i)
         state, carry, metrics = step(state, carry, traces,

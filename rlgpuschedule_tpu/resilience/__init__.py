@@ -12,22 +12,43 @@ this package adds the pieces that make it an actual recovery story:
   deterministically decayed LR, clean give-up after ``max_rollbacks``;
 - :class:`FaultInjector` / :func:`parse_fault` — the deterministic
   fault-injection harness (``nan-grad@K``, ``corrupt-ckpt@K``,
-  ``kill-rank@T[:rank=R]``) that drives every recovery path on CPU in
-  tier-1 tests and from the train CLI (``--fault``);
+  ``kill-rank@T[:rank=R]``, ``lose-rank@T[:rank=R]``) that drives every
+  recovery path on CPU in tier-1 tests and from the train CLI
+  (``--fault``);
 - :class:`HeartbeatWriter` / :class:`HeartbeatMonitor` — per-rank
-  heartbeat files + timeout watchdog for the supervised multihost dryrun
-  (``__graft_entry__.dryrun_multihost_supervised``).
+  heartbeat files (monotonic-clock stamps, atomic writes) + timeout
+  watchdog;
+- :class:`Supervisor` / :class:`RestartPolicy` / :class:`Launcher` —
+  the elastic gang supervisor: detect (exit code / stale heartbeat) →
+  decide (same-size restart from the minimum completed step, or
+  shrink-to-fit relaunch at the surviving world size on permanent rank
+  loss) → relaunch (exponential backoff + jitter, ``max_restarts``
+  budget with a restart-storm guard), terminating in a
+  :class:`SupervisorResult` that reports why. The subprocess gang of
+  the CPU dryrun is one :class:`Launcher`
+  (:class:`SubprocessGangLauncher`); a pod launcher is another.
 
-Checkpoint integrity verification itself (restore the latest step, fall
-back to the previous retained step when it is truncated/corrupt) lives in
-``checkpoint.Checkpointer.restore`` — every restore path gets it for free.
+Checkpoint integrity verification itself (crc32 sidecar pre-check, then
+restore-the-latest-step with fallback to the previous retained step)
+lives in ``checkpoint.Checkpointer`` — every restore path gets it for
+free; shrink-to-fit re-sharding is ``checkpoint.Checkpointer.
+elastic_restore``.
 """
-from .faults import FaultInjector, FaultSpec, corrupt_checkpoint, parse_fault
+from .faults import (KILL_RANK_EXIT, LOSE_RANK_EXIT, FaultInjector,
+                     FaultSpec, corrupt_checkpoint, parse_fault)
 from .heartbeat import HeartbeatMonitor, HeartbeatWriter
+from .supervisor import (Gang, Launcher, LaunchPlan, RestartPolicy,
+                         SubprocessGangLauncher, Supervisor,
+                         SupervisorEvent, SupervisorResult,
+                         SupervisorTimeout)
 from .watchdog import DivergenceError, DivergenceWatchdog, RollbackEvent
 
 __all__ = [
     "DivergenceError", "DivergenceWatchdog", "RollbackEvent",
     "FaultInjector", "FaultSpec", "corrupt_checkpoint", "parse_fault",
+    "KILL_RANK_EXIT", "LOSE_RANK_EXIT",
     "HeartbeatMonitor", "HeartbeatWriter",
+    "Gang", "Launcher", "LaunchPlan", "RestartPolicy",
+    "SubprocessGangLauncher", "Supervisor", "SupervisorEvent",
+    "SupervisorResult", "SupervisorTimeout",
 ]

@@ -17,9 +17,17 @@ tier-1 CPU tests. Three kinds, one per recovery path:
   step.
 - ``kill-rank@T[:rank=R]`` — multihost: rank R calls ``os._exit`` right
   before train step T (before entering the step's collective, so every
-  rank's last durable checkpoint is step T-1). Recovery: the supervised
-  dryrun's heartbeat/exit watchdog restarts the gang from checkpoint.
-  Refused by the single-process train CLI.
+  rank's last durable checkpoint is step T-1), exit code
+  :data:`KILL_RANK_EXIT` — a RESTARTABLE death. Recovery: the
+  :class:`~.supervisor.Supervisor` restarts the gang at the same world
+  size from the minimum completed checkpoint step. Refused by the
+  single-process train CLI.
+- ``lose-rank@T[:rank=R]`` — multihost: same kill-before-the-collective
+  semantics, but exit code :data:`LOSE_RANK_EXIT` marks the rank
+  PERMANENTLY lost (the hardware-gone signature: a host that will not
+  come back). Recovery: the supervisor shrinks the gang to the surviving
+  world size and resumes from the survivors' checkpoints
+  (shrink-to-fit). Refused by the single-process train CLI.
 
 Each fault fires exactly once (a rollback that replays iteration K must
 not re-trip the same injected fault, or no retry could ever succeed).
@@ -32,7 +40,12 @@ import os
 import sys
 from typing import Any
 
-FAULT_KINDS = ("nan-grad", "corrupt-ckpt", "kill-rank")
+FAULT_KINDS = ("nan-grad", "corrupt-ckpt", "kill-rank", "lose-rank")
+
+# exit codes the supervised dryrun's ranks die with; the supervisor keys
+# its restart decision on them (same-size restart vs shrink-to-fit)
+KILL_RANK_EXIT = 17   # restartable death: respawn at the same world size
+LOSE_RANK_EXIT = 23   # permanent loss: relaunch at the surviving world size
 
 
 @dataclasses.dataclass
@@ -64,11 +77,18 @@ def parse_fault(spec: str) -> FaultSpec:
     return FaultSpec(kind=kind, at=int(at), rank=rank)
 
 
-def corrupt_checkpoint(directory: str, step: int) -> int:
+def corrupt_checkpoint(directory: str, step: int,
+                       fix_checksums: bool = False) -> int:
     """Truncate every data blob of checkpoint ``step`` under ``directory``
     to half its size (the truncated-save / partial-write failure mode).
     Returns the number of files corrupted; raises if the step dir has no
-    data files (corrupting nothing would silently test nothing)."""
+    data files (corrupting nothing would silently test nothing).
+
+    ``fix_checksums=True`` re-writes the step's crc32 sidecar AFTER the
+    corruption, so the cheap checksum pre-check passes and the deep
+    failed-load fallback path is the one exercised (an adversarial
+    corruption that keeps the sidecar consistent — e.g. a buggy writer
+    that checksummed what it actually wrote)."""
     step_dir = os.path.join(directory, str(step))
     targets = [f for pat in ("state/d/*", "state/ocdbt.process_*/d/*")
                for f in glob.glob(os.path.join(step_dir, pat))
@@ -79,6 +99,9 @@ def corrupt_checkpoint(directory: str, step: int) -> int:
     for f in targets:
         with open(f, "r+b") as fh:
             fh.truncate(max(os.path.getsize(f) // 2, 1))
+    if fix_checksums:
+        from rlgpuschedule_tpu.checkpoint import write_checksum_sidecar
+        write_checksum_sidecar(directory, step)
     return len(targets)
 
 
@@ -145,13 +168,23 @@ class FaultInjector:
               f"({n} files) after iteration {iteration}",
               file=sys.stderr, flush=True)
 
-    def maybe_kill_rank(self, rank: int, step: int) -> None:
-        """``kill-rank`` hook (multihost worker): rank ``rank`` dies
-        un-gracefully right before train step ``step``."""
+    def maybe_exit_rank(self, rank: int, step: int) -> None:
+        """``kill-rank`` / ``lose-rank`` hook (multihost worker): rank
+        ``rank`` dies un-gracefully right before train step ``step``.
+        ``kill-rank`` exits :data:`KILL_RANK_EXIT` (restartable);
+        ``lose-rank`` exits :data:`LOSE_RANK_EXIT` (permanent loss — the
+        supervisor must shrink the gang instead of respawning rank R)."""
         for s in self.specs:
-            if s.kind == "kill-rank" and s.at == step and s.rank == rank \
-                    and not s.fired:
+            if s.kind in ("kill-rank", "lose-rank") and s.at == step \
+                    and s.rank == rank and not s.fired:
                 s.fired = True
+                code = (KILL_RANK_EXIT if s.kind == "kill-rank"
+                        else LOSE_RANK_EXIT)
                 print(f"fault-injection: rank {rank} dying before step "
-                      f"{step}", file=sys.stderr, flush=True)
-                os._exit(17)
+                      f"{step} ({s.kind}, exit {code})",
+                      file=sys.stderr, flush=True)
+                os._exit(code)
+
+    # back-compat alias (pre-elastic name; same hook, kill-rank only kept
+    # firing through it because old callers only armed kill-rank specs)
+    maybe_kill_rank = maybe_exit_rank

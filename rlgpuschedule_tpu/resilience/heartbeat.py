@@ -5,53 +5,82 @@ A dead rank leaves its peers silently blocked inside a collective; no
 exception ever surfaces on the survivors. Liveness therefore has to be
 observed from OUTSIDE the gang: each rank atomically rewrites a tiny
 ``rank<r>.hb`` file before every train step, and the supervisor
-(``__graft_entry__.dryrun_multihost_supervised``) declares a rank dead
-when its file goes stale past the timeout (or its process exits
-non-zero, the fast path) and restarts the gang from checkpoint.
+(``resilience.supervisor.Supervisor``) declares a rank dead when its
+file goes stale past the timeout (or its process exits non-zero, the
+fast path) and restarts the gang from checkpoint.
 
 Files, not sockets: the supervisor and workers already share a
 filesystem, an atomic rename is crash-consistent, and a stale file is
 exactly the failure signature we need — a hung rank stops renaming.
+
+Clock discipline: beats carry ``time.monotonic()`` stamps, NOT wall
+time. Wall clocks jump (NTP slew/step, manual adjustment); a backward
+jump makes a dead rank's file look fresh (false-alive) and a forward
+jump makes a live rank look stale (false-stale) — both were possible
+with the original ``time.time()`` stamps. CLOCK_MONOTONIC is shared by
+every process on one host, which is exactly the supervised dryrun's
+topology (supervisor + ranks on one machine). Cross-HOST supervision
+needs stamps the reader generates itself (e.g. file mtimes under the
+reader's clock) and belongs to the pod-launcher integration.
+
+Writes are torn-proof: content goes to a writer-private tmp file
+(pid-suffixed, so a not-yet-reaped predecessor rank can't interleave
+with its replacement) and lands via ``os.replace`` — a reader sees the
+old beat or the new one, never half a line.
 """
 from __future__ import annotations
 
 import os
 import time
+from typing import Callable
 
 
 class HeartbeatWriter:
     """One rank's side: ``beat(step)`` atomically rewrites the rank file
-    with the current step and wall time."""
+    with the current step and a monotonic timestamp. ``clock`` is
+    injectable for tests; the default (``time.monotonic``) must match the
+    monitor's."""
 
-    def __init__(self, directory: str, rank: int):
+    def __init__(self, directory: str, rank: int,
+                 clock: Callable[[], float] = time.monotonic):
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, f"rank{rank}.hb")
-        self._tmp = self.path + ".tmp"
+        # pid-unique tmp: after a gang restart the old rank process may
+        # not be fully reaped yet; a shared tmp name would let its last
+        # in-flight write race the new rank's
+        self._tmp = f"{self.path}.tmp.{os.getpid()}"
+        self._clock = clock
 
     def beat(self, step: int) -> None:
         with open(self._tmp, "w") as f:
-            f.write(f"{step} {time.time()}")
+            f.write(f"{step} {self._clock()}")
         os.replace(self._tmp, self.path)   # atomic on POSIX
 
 
 class HeartbeatMonitor:
     """Supervisor's side: which ranks have not beaten within
-    ``timeout_s``? A rank with no file yet is judged against the
-    monitor's start time (grace for slow jax/XLA startup)."""
+    ``timeout_s``? The staleness threshold is a constructor argument —
+    it must scale with the deployment's longest legitimate beat-free
+    stretch (XLA compile of the step program), which no constant can
+    know. A rank with no file yet is judged against the monitor's start
+    time (grace for slow jax/XLA startup)."""
 
-    def __init__(self, directory: str, n_ranks: int, timeout_s: float):
+    def __init__(self, directory: str, n_ranks: int, timeout_s: float,
+                 clock: Callable[[], float] = time.monotonic):
         self.directory = directory
         self.n_ranks = n_ranks
         self.timeout_s = timeout_s
-        self._t0 = time.time()
+        self._clock = clock
+        self._t0 = clock()
 
     def restart(self) -> None:
         """Re-arm the missing-file grace window (call when the gang is
         (re)spawned)."""
-        self._t0 = time.time()
+        self._t0 = self._clock()
 
     def read(self) -> dict[int, tuple[int, float]]:
-        """{rank: (last step, beat wall time)} for ranks that have beaten."""
+        """{rank: (last step, beat monotonic time)} for ranks that have
+        beaten."""
         out = {}
         for r in range(self.n_ranks):
             path = os.path.join(self.directory, f"rank{r}.hb")
@@ -64,7 +93,7 @@ class HeartbeatMonitor:
         return out
 
     def stale_ranks(self, now: float | None = None) -> list[int]:
-        now = time.time() if now is None else now
+        now = self._clock() if now is None else now
         beats = self.read()
         stale = []
         for r in range(self.n_ranks):

@@ -1,0 +1,452 @@
+"""Elastic gang supervision (SURVEY.md §5 "Failure detection / elastic
+recovery"): the detect → decide → relaunch loop, extracted from
+``__graft_entry__.dryrun_multihost_supervised`` into a reusable
+abstraction.
+
+Podracer-style gang architectures treat the accelerator gang as a
+resizable resource; this module makes the recovery path treat it the
+same way. The pieces:
+
+- :class:`Launcher` — pluggable "how do I start a gang" interface. The
+  subprocess gang of the CPU dryrun (:class:`SubprocessGangLauncher`) is
+  one implementation; a GKE/ray pod launcher is another Launcher away
+  and changes nothing above it.
+- :class:`RestartPolicy` — exponential backoff with deterministic
+  jitter, a ``max_restarts`` budget, and a restart-storm guard: a
+  failure that lands within the backoff window of the previous one
+  (i.e. the gang died ~immediately after relaunch) charges DOUBLE
+  against the budget, so a crash-looping gang terminates early instead
+  of burning the whole budget at full speed.
+- :class:`Supervisor` — owns the loop. Detection is exit codes (the
+  fast signal) plus heartbeat staleness (the general one — a dead rank
+  leaves its PEERS silently blocked inside the collective, so liveness
+  must be observed from outside the gang). Decision: a rank that exits
+  with a code in ``permanent_exit_codes`` (``faults.LOSE_RANK_EXIT``)
+  is PERMANENTLY lost — the gang relaunches shrunk to the surviving
+  world size, each new rank restoring a surviving old rank's
+  checkpoint (shrink-to-fit); any other death restarts at the same
+  size from the gang-wide minimum completed step. Termination is a
+  :class:`SupervisorResult` that always says WHY: ``completed``, or
+  ``gave_up`` with the budget/floor reason spelled out.
+
+The supervisor never inspects jax state — it sees processes, exit
+codes, heartbeat files and checkpoint sidecars, which is exactly what a
+production pod supervisor sees.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, Sequence
+
+from .faults import LOSE_RANK_EXIT
+from .heartbeat import HeartbeatMonitor
+
+
+class SupervisorTimeout(RuntimeError):
+    """The overall deadline elapsed with a gang still running (a hang the
+    heartbeat timeout did not attribute to any single rank)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class LaunchPlan:
+    """One (re)launch decision. ``restore_ranks`` maps each NEW rank i to
+    the OLD rank whose checkpoint it must restore (shrink-to-fit: new
+    rank i resumes from ``restore_ranks[i]``'s files); ``None`` means
+    identity (every rank restores its own)."""
+    world_size: int
+    attempt: int = 0                       # 0 = first launch
+    resume_step: int | None = None         # None = fresh start
+    restore_ranks: tuple[int, ...] | None = None
+
+
+@dataclasses.dataclass
+class SupervisorEvent:
+    """One detected failure and what it cost."""
+    attempt: int
+    world_size: int
+    rank: int
+    detected_by: str       # "exit=N" | "heartbeat>Ts"
+    permanent: bool
+    charge: int            # 1, or 2 when the storm guard doubled it
+
+
+@dataclasses.dataclass
+class SupervisorResult:
+    """Terminal state of a supervised run. ``outcome`` is ``"completed"``
+    or ``"gave_up"``; ``reason`` spells out why a run gave up (budget
+    exhausted, world floor) and is ``None`` on success."""
+    outcome: str
+    reason: str | None
+    restarts: int
+    world_size: int
+    resume_step: int | None
+    detected_by: str | None
+    outputs: list[str]
+    events: list[SupervisorEvent]
+    budget_spent: int
+    storm_charges: int
+
+    @property
+    def shrunk(self) -> bool:
+        return any(e.permanent for e in self.events)
+
+
+class Gang:
+    """A launched gang. ``poll()`` returns one exit code per rank (None =
+    still running); ``kill()`` tears every rank down; ``outputs()``
+    returns each rank's full captured output (diagnostics +
+    report-parsing)."""
+
+    def poll(self) -> list[int | None]:
+        raise NotImplementedError
+
+    def kill(self) -> None:
+        raise NotImplementedError
+
+    def outputs(self) -> list[str]:
+        raise NotImplementedError
+
+    def tails(self, n: int = 500) -> list[str]:
+        return [out[-n:] for out in self.outputs()]
+
+
+class Launcher:
+    """How gangs start and where their durable progress lives. The
+    supervisor only ever calls these three methods — swapping the
+    subprocess gang for a pod launcher is one subclass."""
+
+    world_size: int   # the initial (full) world size
+
+    def launch(self, plan: LaunchPlan) -> Gang:
+        raise NotImplementedError
+
+    def completed_steps(self, ranks: Sequence[int]) -> dict[int, int]:
+        """{rank: last durably completed step} for the ranks that have
+        one. Ranks with no durable checkpoint are simply absent."""
+        raise NotImplementedError
+
+
+class RestartPolicy:
+    """Restart budget + exponential backoff + deterministic jitter + the
+    restart-storm guard.
+
+    A failure is "stormy" when it lands within ``storm_window_s`` of the
+    previous failure (default: the backoff delay just applied plus one
+    base backoff — i.e. the gang died about as fast as it came up) and
+    charges 2 against ``max_restarts`` instead of 1. ``exhausted()``
+    is true once charges EXCEED ``max_restarts`` (a budget of N allows N
+    healthy restarts).
+
+    Jitter is drawn from a seeded PRNG so a supervised run is exactly
+    reproducible; distinct supervisors should get distinct
+    ``jitter_seed``s (that is the point of jitter — decorrelating
+    thundering-herd relaunches)."""
+
+    def __init__(self, max_restarts: int, backoff_s: float = 1.0,
+                 backoff_max_s: float = 30.0, jitter_frac: float = 0.25,
+                 jitter_seed: int = 0,
+                 storm_window_s: float | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.backoff_max_s = backoff_max_s
+        self.jitter_frac = jitter_frac
+        self.storm_window_s = storm_window_s
+        self._rng = random.Random(jitter_seed)
+        self._clock = clock
+        self.failures = 0          # failures observed
+        self.spent = 0             # budget charges (>= failures)
+        self.storm_charges = 0     # how many failures were double-charged
+        self._last_failure_t: float | None = None
+        self._last_delay = 0.0
+
+    def record_failure(self) -> int:
+        """Account one detected failure; returns the charge (1 or 2)."""
+        now = self._clock()
+        window = (self.storm_window_s if self.storm_window_s is not None
+                  else self._last_delay + self.backoff_s)
+        charge = 1
+        if (self._last_failure_t is not None
+                and now - self._last_failure_t <= window):
+            charge = 2
+            self.storm_charges += 1
+        self.failures += 1
+        self.spent += charge
+        self._last_failure_t = now
+        return charge
+
+    def exhausted(self) -> bool:
+        return self.spent > self.max_restarts
+
+    def next_delay(self) -> float:
+        """Backoff before the next relaunch: exponential in the failure
+        count, capped, jittered upward by up to ``jitter_frac``."""
+        base = min(self.backoff_s * 2 ** max(self.failures - 1, 0),
+                   self.backoff_max_s)
+        self._last_delay = base * (1.0 + self.jitter_frac
+                                   * self._rng.random())
+        return self._last_delay
+
+
+@dataclasses.dataclass
+class _Failure:
+    rank: int
+    detected_by: str
+    permanent: bool
+
+
+class Supervisor:
+    """Drives one supervised run to a terminal state. See module
+    docstring for the loop; ``monitor_factory(world_size)`` builds the
+    heartbeat monitor for each (re)launch (fresh monitor = fresh
+    missing-file grace window at the CURRENT world size), or ``None``
+    for exit-code-only detection (unit tests with fake launchers)."""
+
+    def __init__(self, launcher: Launcher, policy: RestartPolicy, *,
+                 monitor_factory: Callable[[int], HeartbeatMonitor]
+                 | None = None,
+                 min_world: int = 1,
+                 permanent_exit_codes: tuple[int, ...] = (LOSE_RANK_EXIT,),
+                 deadline_s: float = 900.0, poll_interval_s: float = 0.2,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic,
+                 log: Callable[[str], None] | None = None):
+        self.launcher = launcher
+        self.policy = policy
+        self.monitor_factory = monitor_factory
+        self.min_world = min_world
+        self.permanent_exit_codes = tuple(permanent_exit_codes)
+        self.deadline_s = deadline_s
+        self.poll_interval_s = poll_interval_s
+        self._sleep = sleep
+        self._clock = clock
+        self._log = log or (lambda msg: print(msg, flush=True))
+
+    def run(self) -> SupervisorResult:
+        deadline = self._clock() + self.deadline_s
+        world = self.launcher.world_size
+        plan = LaunchPlan(world_size=world)
+        events: list[SupervisorEvent] = []
+
+        def result(outcome, reason, outputs, detected_by):
+            return SupervisorResult(
+                outcome=outcome, reason=reason, restarts=plan.attempt,
+                world_size=world, resume_step=plan.resume_step,
+                detected_by=detected_by, outputs=outputs, events=events,
+                budget_spent=self.policy.spent,
+                storm_charges=self.policy.storm_charges)
+
+        while True:
+            gang = self.launcher.launch(plan)
+            monitor = (self.monitor_factory(world)
+                       if self.monitor_factory else None)
+            failure = self._watch(gang, monitor, deadline)
+            if failure is None:
+                return result("completed", None, gang.outputs(),
+                              events[-1].detected_by if events else None)
+            gang.kill()
+            charge = self.policy.record_failure()
+            events.append(SupervisorEvent(
+                attempt=plan.attempt, world_size=world, rank=failure.rank,
+                detected_by=failure.detected_by,
+                permanent=failure.permanent, charge=charge))
+            if self.policy.exhausted():
+                storm = (f", {self.policy.storm_charges} storm-doubled"
+                         if self.policy.storm_charges else "")
+                reason = (
+                    f"restart budget exhausted: {self.policy.failures} "
+                    f"failures charged {self.policy.spent} against "
+                    f"max_restarts={self.policy.max_restarts}{storm}; "
+                    f"last: rank {failure.rank} ({failure.detected_by})")
+                self._log(f"supervisor: giving up — {reason}")
+                return result("gave_up", reason, gang.outputs(),
+                              failure.detected_by)
+            if failure.permanent:
+                survivors = [r for r in range(world) if r != failure.rank]
+                if len(survivors) < self.min_world:
+                    reason = (
+                        f"rank {failure.rank} permanently lost "
+                        f"({failure.detected_by}) at world size {world}; "
+                        f"surviving world {len(survivors)} is below "
+                        f"min_world={self.min_world}")
+                    self._log(f"supervisor: giving up — {reason}")
+                    return result("gave_up", reason, gang.outputs(),
+                                  failure.detected_by)
+                done = self.launcher.completed_steps(survivors)
+                if set(done) >= set(survivors):
+                    resume = min(done[r] for r in survivors)
+                    restore = tuple(survivors)
+                else:
+                    resume, restore = None, None   # fresh, but smaller
+                world = len(survivors)
+                self._log(
+                    f"supervisor: rank {failure.rank} permanently lost "
+                    f"({failure.detected_by}); shrinking gang to world "
+                    f"size {world}"
+                    + (f", resuming from checkpoint step {resume}"
+                       if resume is not None else ", restarting fresh"))
+            else:
+                done = self.launcher.completed_steps(list(range(world)))
+                if len(done) == world:
+                    resume, restore = min(done.values()), None
+                    self._log(
+                        f"supervisor: rank {failure.rank} dead "
+                        f"({failure.detected_by}); restarting gang from "
+                        f"checkpoint step {resume}")
+                else:
+                    # a rank died before every rank had a durable
+                    # checkpoint: restart FRESH — a resume step would
+                    # point ranks at files that do not exist and crash
+                    # the restarted gang
+                    resume, restore = None, None
+                    self._log(
+                        f"supervisor: rank {failure.rank} dead "
+                        f"({failure.detected_by}) before all ranks "
+                        f"checkpointed ({len(done)}/{world}); restarting "
+                        f"fresh")
+            self._sleep(self.policy.next_delay())
+            plan = LaunchPlan(world_size=world, attempt=plan.attempt + 1,
+                              resume_step=resume, restore_ranks=restore)
+
+    def _watch(self, gang: Gang, monitor, deadline) -> _Failure | None:
+        """Block until the gang completes (``None``) or one failure is
+        attributed. Raises :class:`SupervisorTimeout` at the deadline."""
+        while True:
+            if self._clock() > deadline:
+                gang.kill()
+                raise SupervisorTimeout(
+                    f"supervised run exceeded its {self.deadline_s:.0f}s "
+                    f"deadline; rank logs: " + " | ".join(gang.tails()))
+            codes = gang.poll()
+            if all(c == 0 for c in codes):
+                return None
+            bad = [(r, c) for r, c in enumerate(codes)
+                   if c is not None and c != 0]
+            if bad:
+                # a permanent-loss exit wins attribution: peers torn down
+                # by the death often exit non-zero too, and restarting
+                # same-size on a peer's code would miss the shrink
+                perm = [(r, c) for r, c in bad
+                        if c in self.permanent_exit_codes]
+                rank, code = perm[0] if perm else bad[0]
+                return _Failure(rank=rank, detected_by=f"exit={code}",
+                                permanent=bool(perm))
+            if monitor is not None:
+                stale = monitor.stale_ranks()
+                if stale:
+                    return _Failure(
+                        rank=stale[0],
+                        detected_by=f"heartbeat>{monitor.timeout_s}s",
+                        permanent=False)
+            self._sleep(self.poll_interval_s)
+
+
+class SubprocessGang(Gang):
+    def __init__(self, procs, logs):
+        self._procs = procs
+        self._logs = logs
+
+    def poll(self) -> list[int | None]:
+        return [p.poll() for p in self._procs]
+
+    def kill(self) -> None:
+        for p in self._procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    def outputs(self) -> list[str]:
+        outs = []
+        for log in self._logs:
+            log.flush()
+            with open(log.name) as f:
+                outs.append(f.read())
+        return outs
+
+
+class SubprocessGangLauncher(Launcher):
+    """The CPU dryrun's gang: N fresh ``multihost_worker`` processes on
+    localhost, heartbeats + per-rank npz checkpoints under ``base_dir``.
+    Caller owns ``base_dir`` (and its cleanup) and supplies the scrubbed
+    child environment (the rig-specific hygiene — compile-cache scrub,
+    platform pins — stays with the caller; see ``__graft_entry__``).
+
+    Fault flags are armed only on full-world fresh launches: a resumed
+    or shrunk gang re-armed with ``kill-rank``/``lose-rank`` would
+    re-fire the drill forever (each relaunch is a fresh process with
+    fresh ``FaultSpec.fired`` state)."""
+
+    def __init__(self, *, n_processes: int, devices_per_process: int,
+                 steps: int, env: dict, base_dir: str,
+                 faults: Sequence[str] = (), repo_root: str | None = None):
+        self.world_size = n_processes
+        self._initial_world = n_processes
+        self.devices_per_process = devices_per_process
+        self.steps = steps
+        self.env = env
+        self.base_dir = base_dir
+        self.faults = tuple(faults)
+        self.repo_root = repo_root or os.getcwd()
+        self.hb_dir = os.path.join(base_dir, "hb")
+        self.ckpt_dir = os.path.join(base_dir, "ckpt")
+        os.makedirs(self.hb_dir, exist_ok=True)
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+
+    def launch(self, plan: LaunchPlan) -> SubprocessGang:
+        # stale heartbeat files from the previous gang instance would be
+        # judged against the new monitor's clock; drop them so every
+        # launch starts inside the missing-file grace window
+        for name in os.listdir(self.hb_dir):
+            if name.endswith(".hb"):
+                os.unlink(os.path.join(self.hb_dir, name))
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs, logs = [], []
+        for pid in range(plan.world_size):
+            cmd = [sys.executable, "-m",
+                   "rlgpuschedule_tpu.parallel.multihost_worker",
+                   "--coordinator", f"127.0.0.1:{port}",
+                   "--num-procs", str(plan.world_size),
+                   "--proc-id", str(pid),
+                   "--devices-per-proc", str(self.devices_per_process),
+                   "--steps", str(self.steps),
+                   "--heartbeat-dir", self.hb_dir,
+                   "--ckpt-dir", self.ckpt_dir, "--no-pbt-check"]
+            if plan.resume_step is not None:
+                cmd += ["--resume-step", str(plan.resume_step)]
+                if plan.restore_ranks is not None:
+                    cmd += ["--restore-rank",
+                            str(plan.restore_ranks[pid])]
+            if (plan.resume_step is None
+                    and plan.world_size == self._initial_world):
+                for f in self.faults:
+                    cmd += ["--fault", f]
+            log = tempfile.NamedTemporaryFile(
+                "w+", suffix=f".a{plan.attempt}.rank{pid}.log",
+                delete=False, dir=self.base_dir)
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                cmd, stdout=log, stderr=subprocess.STDOUT, text=True,
+                env=self.env, cwd=self.repo_root))
+        return SubprocessGang(procs, logs)
+
+    def completed_steps(self, ranks: Sequence[int]) -> dict[int, int]:
+        out = {}
+        for r in ranks:
+            try:
+                path = os.path.join(self.ckpt_dir, f"rank{r}.step")
+                with open(path) as f:
+                    out[r] = int(f.read().strip())
+            except (FileNotFoundError, ValueError):
+                pass
+        return out

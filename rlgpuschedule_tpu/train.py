@@ -169,9 +169,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "nan-grad@K poisons params+metrics at iteration "
                         "K (PBT: rank=M selects the member), "
                         "corrupt-ckpt@K truncates the checkpoint saved "
-                        "at iteration K. kill-rank is refused here "
-                        "(multihost only — drive it with __graft_entry__."
-                        "dryrun_multihost_supervised)")
+                        "at iteration K. kill-rank/lose-rank are refused "
+                        "here (multihost only — drive them with "
+                        "__graft_entry__.dryrun_multihost_supervised / "
+                        "dryrun_multihost_elastic)")
     p.add_argument("--report", action="store_true",
                    help="print the JCT-vs-baselines table after training "
                         "(single-run, non-hierarchical configs)")
@@ -354,10 +355,11 @@ def main(argv: list[str] | None = None) -> dict:
             faults = [parse_fault(s) for s in args.fault]
         except ValueError as e:
             sys.exit(str(e))
-        if any(f.kind == "kill-rank" for f in faults):
-            sys.exit("kill-rank is a multihost fault and this CLI is one "
-                     "process; drive it with "
-                     "__graft_entry__.dryrun_multihost_supervised")
+        if any(f.kind in ("kill-rank", "lose-rank") for f in faults):
+            sys.exit("kill-rank/lose-rank are multihost faults and this "
+                     "CLI is one process; drive them with __graft_entry__"
+                     ".dryrun_multihost_supervised / "
+                     "dryrun_multihost_elastic")
         if any(f.kind == "corrupt-ckpt" for f in faults) \
                 and not args.ckpt_dir:
             sys.exit("--fault corrupt-ckpt requires --ckpt-dir (no "

@@ -46,6 +46,14 @@ def pytest_configure(config):
         "NaN on purpose (the resilience fault-injection tests)")
     config.addinivalue_line(
         "markers",
+        "multihost_spawn: spawns a real multi-process jax.distributed "
+        "gang (tests/test_multihost.py). CPU-contention-sensitive on "
+        "small rigs — gloo's collective rendezvous races per-rank XLA "
+        "compile — so ci.sh runs this subset serially AFTER the main "
+        "tier-1 pass; the tests still run (not skipped) under a plain "
+        "-m 'not slow' invocation")
+    config.addinivalue_line(
+        "markers",
         "perf: wall-clock performance measurements (update-geometry "
         "timing assertions). Opt-in via `-m perf`: timing asserts are "
         "load-sensitive on the shared 1-core CI host, so tier-1 skips "

@@ -1,7 +1,10 @@
 """Checkpoint/resume tests (SURVEY.md §5 "Checkpoint / resume"): Orbax
-roundtrip of TrainState, rotation, meta payloads, sharded restore, and
-experiment-level resume determinism."""
+roundtrip of TrainState, rotation, meta payloads, sharded restore,
+experiment-level resume determinism, crc32 integrity sidecars, and
+shrink-to-fit elastic restore (ISSUE 4)."""
 import dataclasses
+import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -10,7 +13,8 @@ import optax
 import pytest
 from flax.training.train_state import TrainState
 
-from rlgpuschedule_tpu.checkpoint import Checkpointer
+from rlgpuschedule_tpu.checkpoint import (Checkpointer, ElasticRestoreError,
+                                          validate_shrunk_geometry)
 from rlgpuschedule_tpu.algos import PPOConfig
 from rlgpuschedule_tpu.configs import CONFIGS
 from rlgpuschedule_tpu.experiment import Experiment
@@ -83,6 +87,137 @@ class TestCheckpointer:
             restored, _, _, _ = ck.restore(template)
         assert restored.params["w"].sharding == state.params["w"].sharding
         assert np.allclose(restored.params["w"], 3.0)
+
+
+class TestChecksumSidecars:
+    def test_wait_writes_sidecar_per_retained_step(self, tmp_path):
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            ck.save(1, _mk_state(1.0, 1))
+            ck.save(2, _mk_state(2.0, 2))
+            ck.wait()
+            d = ck.directory
+            for s in (1, 2):
+                path = os.path.join(d, ".crc", f"{s}.json")
+                assert os.path.exists(path)
+                sums = json.load(open(path))
+                # every payload file is covered, with plausible crc32s
+                assert sums and all(isinstance(v, int) for v in
+                                    sums.values())
+                assert all(os.path.exists(os.path.join(d, str(s), rel))
+                           for rel in sums)
+
+    def test_rotation_prunes_stale_sidecars(self, tmp_path):
+        with Checkpointer(str(tmp_path / "ck"), max_to_keep=2) as ck:
+            for s in range(4):
+                ck.save(s, _mk_state(float(s), step=s))
+                ck.wait()
+            assert ck.all_steps() == [2, 3]
+            crc_dir = os.path.join(ck.directory, ".crc")
+            assert sorted(os.listdir(crc_dir)) == ["2.json", "3.json"]
+
+    def test_force_overwrite_refreshes_sidecar(self, tmp_path):
+        with Checkpointer(str(tmp_path / "ck")) as ck:
+            ck.save(3, _mk_state(1.0, 3))
+            ck.wait()
+            before = json.load(open(
+                os.path.join(ck.directory, ".crc", "3.json")))
+            ck.save(3, _mk_state(9.0, 3), force=True)
+            after = json.load(open(
+                os.path.join(ck.directory, ".crc", "3.json")))
+            # different params => different payload bytes => new crcs
+            assert before != after
+            restored, _, _, _ = ck.restore(_mk_state(0.0))
+        assert np.allclose(restored.params["w"], 9.0)
+
+
+class TestElasticRestore:
+    """Shrink-to-fit restore (ISSUE 4 satellite): a checkpoint written at
+    world size N restores onto N-k surviving shards — replicated state
+    bit-exact, env-batched extras reduced to the surviving ranks' row
+    blocks, untileable geometry refused up front."""
+
+    def _save_world8(self, tmp_path, n_envs=8):
+        from rlgpuschedule_tpu.parallel import make_mesh
+        from rlgpuschedule_tpu.parallel.mesh import replicated
+
+        mesh8 = make_mesh(8)
+        state = jax.device_put(_mk_state(3.5, step=5), replicated(mesh8))
+        extra = {"obs": np.arange(n_envs * 3, dtype=np.float32)
+                 .reshape(n_envs, 3),
+                 "done": np.arange(n_envs) % 2 == 0}
+        key = jax.random.PRNGKey(11)
+        ck = Checkpointer(str(tmp_path / "ck"))
+        ck.save(5, state, key=key, extra=extra, meta={"it": 5})
+        ck.wait()
+        return ck, state, extra, key
+
+    def test_shrink_is_bit_exact_on_surviving_shards(self, tmp_path):
+        """8 shards -> 4 survivors: params/opt_state restore bit-exact
+        (replicated state is world-size independent) and each surviving
+        shard's env rows come back exactly as saved."""
+        from rlgpuschedule_tpu.parallel import make_mesh
+        from rlgpuschedule_tpu.parallel.mesh import replicated
+
+        ck, state, extra, key = self._save_world8(tmp_path)
+        surviving = [0, 2, 3, 5]
+        mesh4 = make_mesh(4)
+        restored, rkey, rextra, meta = ck.elastic_restore(
+            _mk_state(0.0), old_world=8, surviving_ranks=surviving,
+            mesh=mesh4, geometry=(1, 2, None, 8))
+        assert meta == {"it": 5} and int(restored.step) == 5
+        for a, b in zip(jax.tree.leaves(restored.params),
+                        jax.tree.leaves(state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(restored.opt_state),
+                        jax.tree.leaves(state.opt_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(rkey), np.asarray(key))
+        # env-batched extras: exactly the surviving shards' rows (1 row
+        # per shard at 8 envs / 8 shards), order-preserving
+        np.testing.assert_array_equal(rextra["obs"],
+                                      extra["obs"][surviving])
+        np.testing.assert_array_equal(rextra["done"],
+                                      extra["done"][surviving])
+        # state landed replicated on the SURVIVING mesh
+        assert restored.params["w"].sharding.is_equivalent_to(
+            replicated(mesh4), ndim=2)
+        ck.close()
+
+    def test_multi_row_shards_keep_contiguous_blocks(self, tmp_path):
+        ck, _state, extra, _key = self._save_world8(tmp_path)
+        # 8 envs over 4 saved shards = 2 rows per shard; survivors {0, 3}
+        restored, _, rextra, _ = ck.elastic_restore(
+            _mk_state(0.0), old_world=4, surviving_ranks=[0, 3])
+        np.testing.assert_array_equal(rextra["obs"],
+                                      extra["obs"][[0, 1, 6, 7]])
+        ck.close()
+
+    def test_untileable_shrink_fails_fast(self, tmp_path):
+        """The fail-fast gate: a surviving batch the update geometry
+        cannot tile raises ElasticRestoreError naming the shrink — not a
+        shape error mid-step."""
+        ck, *_ = self._save_world8(tmp_path)
+        with pytest.raises(ElasticRestoreError,
+                           match="shrink-to-fit.*does not tile"):
+            ck.elastic_restore(_mk_state(0.0), old_world=8,
+                               surviving_ranks=[0, 1, 2],
+                               geometry=(1, 7, None, 8))
+        ck.close()
+
+    def test_shrunk_batch_must_divide_surviving_mesh(self, tmp_path):
+        from rlgpuschedule_tpu.parallel import make_mesh
+
+        ck, *_ = self._save_world8(tmp_path)
+        with pytest.raises(ElasticRestoreError, match="data axis"):
+            ck.elastic_restore(_mk_state(0.0), old_world=8,
+                               surviving_ranks=[0, 1, 2],
+                               mesh=make_mesh(2))
+        ck.close()
+
+    def test_validate_shrunk_geometry_passthrough_and_error(self):
+        assert validate_shrunk_geometry(1, 2, None, 8, 6) == (1, 2, 24)
+        with pytest.raises(ElasticRestoreError, match="was 64"):
+            validate_shrunk_geometry(1, 7, None, 8, 3, old_n_envs=8)
 
 
 class TestExperimentResume:

@@ -7,9 +7,15 @@ cross the process boundary — the same program shape a 2-host v5e-16
 deployment runs, with gloo standing in for DCN. The in-process helpers
 (``process_env_slice``, ``global_traces``) are additionally unit-tested on
 the conftest's single-process 8-device mesh, where global == local.
+
+Every test that spawns a real gang carries the ``multihost_spawn``
+marker: they are CPU-contention-sensitive (gloo's ~30s collective
+rendezvous races per-rank XLA compile on a loaded small rig), so
+``ci.sh`` runs this subset serially AFTER the main tier-1 pass.
 """
 import numpy as np
 import jax
+import pytest
 
 from rlgpuschedule_tpu.parallel import make_mesh
 from rlgpuschedule_tpu.parallel import multihost
@@ -39,6 +45,7 @@ class TestHelpersSingleProcess:
         assert m.devices.size == len(jax.devices())
 
 
+@pytest.mark.multihost_spawn
 def test_dryrun_multihost_2proc():
     """The real gate: 2 fresh processes, cross-process psum + PBT gather.
     Raises on rank failure, fingerprint disagreement, or timeout.
@@ -51,12 +58,14 @@ def test_dryrun_multihost_2proc():
     ge.dryrun_multihost(n_processes=2, devices_per_process=2)
 
 
+@pytest.mark.multihost_spawn
 def test_dryrun_multihost_supervised_recovers_killed_rank():
-    """Acceptance path 3 (ISSUE 1): rank 1 is fault-injected to die right
-    before step 2; the supervisor detects the death (fast path: non-zero
-    exit; general path: stale heartbeat), restarts the gang from the
-    per-rank step-2 checkpoints, and the restarted ranks finish with
-    IDENTICAL replicated-params fingerprints — i.e. restart-from-checkpoint
+    """Acceptance (a), ISSUE 4: rank 1 is fault-injected to die right
+    before step 2 (kill-rank — a RESTARTABLE death); the supervisor
+    detects it (fast path: non-zero exit; general path: stale heartbeat),
+    restarts the gang AT THE SAME world size from the per-rank step-2
+    checkpoints, and the restarted ranks finish with IDENTICAL
+    replicated-params fingerprints — i.e. restart-from-checkpoint
     preserved the collective's state, losing at most one step of work."""
     import __graft_entry__ as ge
 
@@ -68,3 +77,28 @@ def test_dryrun_multihost_supervised_recovers_killed_rank():
     # a peer torn down mid-step may be one behind — at most one step lost
     assert out["resume_step"] >= 1
     assert out["detected_by"].startswith(("exit=", "heartbeat"))
+    assert out["world_size"] == 2 and not out["shrunk"]
+
+
+@pytest.mark.multihost_spawn
+def test_dryrun_multihost_elastic_shrinks_to_surviving_world():
+    """Acceptance (b), ISSUE 4 — shrink-to-fit: rank 1 of 3 is
+    PERMANENTLY lost (lose-rank -> exit 23) before step 2. The
+    supervisor must relaunch at world size 2, mapping the new ranks onto
+    the SURVIVING old ranks' checkpoints (replicated state re-seeds the
+    shrunk gang from the survivors' minimum completed step), and the
+    2-rank gang must finish with MATCHING cross-rank fingerprints at the
+    new size — the fingerprint contract holds at any world size.
+    1 device per rank: the surface under test is the world-size change,
+    and the smaller per-rank mesh keeps 3+2 spawned compiles cheap."""
+    import __graft_entry__ as ge
+
+    from rlgpuschedule_tpu.resilience import LOSE_RANK_EXIT
+
+    out = ge.dryrun_multihost_elastic(
+        n_processes=3, devices_per_process=1, steps=4, lose_step=2,
+        lose_rank=1)
+    assert out["shrunk"] and out["world_size"] == 2
+    assert out["restarts"] == 1
+    assert out["resume_step"] >= 1
+    assert out["detected_by"] == f"exit={LOSE_RANK_EXIT}"

@@ -8,10 +8,13 @@ deterministic fault-injection harness on CPU —
   the previous retained step (and raises ``CheckpointRestoreError`` only
   when EVERY retained step is corrupt);
 - a dead PBT member (non-finite fitness) -> exploit re-seeds it from the
-  best finite member instead of letting NaN win the tournament.
+  best finite member instead of letting NaN win the tournament;
+- the elastic gang supervisor (ISSUE 4): restart/shrink decisions,
+  restart-storm double-charging, budget/floor give-up reasons — unit
+  tested against scripted fake launchers (no processes spawned).
 
-The killed-multihost-rank path lives in ``test_multihost.py`` (it spawns
-real processes); this file covers everything in-process.
+The killed/lost-multihost-rank paths live in ``test_multihost.py`` (they
+spawn real gangs); this file covers everything in-process.
 """
 import dataclasses
 import math
@@ -28,9 +31,13 @@ from rlgpuschedule_tpu.checkpoint import Checkpointer, CheckpointRestoreError
 from rlgpuschedule_tpu.configs import CONFIGS
 from rlgpuschedule_tpu.experiment import Experiment, PopulationExperiment
 from rlgpuschedule_tpu.parallel import (HParams, PBTConfig, exploit_explore)
-from rlgpuschedule_tpu.resilience import (DivergenceError,
+from rlgpuschedule_tpu.resilience import (KILL_RANK_EXIT, LOSE_RANK_EXIT,
+                                          DivergenceError,
                                           DivergenceWatchdog, FaultInjector,
-                                          HeartbeatMonitor, HeartbeatWriter,
+                                          Gang, HeartbeatMonitor,
+                                          HeartbeatWriter, Launcher,
+                                          RestartPolicy, Supervisor,
+                                          SupervisorTimeout,
                                           corrupt_checkpoint, parse_fault)
 
 # same shapes as test_checkpoint's resume tests so the persistent XLA
@@ -54,6 +61,8 @@ class TestParseFault:
         assert (s.kind, s.at, s.rank) == ("kill-rank", 2, 1)
         s = parse_fault("corrupt-ckpt@7")
         assert (s.kind, s.at) == ("corrupt-ckpt", 7)
+        s = parse_fault("lose-rank@2:rank=1")
+        assert (s.kind, s.at, s.rank) == ("lose-rank", 2, 1)
 
     @pytest.mark.parametrize("bad", ["nan@3", "nan-grad", "nan-grad@x",
                                      "nan-grad@3:bogus=2", "@2", ""])
@@ -117,6 +126,236 @@ class TestHeartbeat:
         assert 0 in mon.stale_ranks() and 1 not in mon.stale_ranks()
         hb.beat(1)
         assert 0 not in mon.stale_ranks()
+
+    def test_monotonic_clock_immune_to_wall_jump(self, tmp_path):
+        """Beats carry monotonic stamps: a wall-clock step (NTP) between
+        beat and check can neither fake staleness nor fake liveness.
+        Simulated with injected clocks — the writer and monitor share one
+        monotonic source while the 'wall clock' jumps an hour."""
+        mono = [100.0]
+        hb = HeartbeatWriter(str(tmp_path), rank=0, clock=lambda: mono[0])
+        mon = HeartbeatMonitor(str(tmp_path), n_ranks=1, timeout_s=5.0,
+                               clock=lambda: mono[0])
+        hb.beat(0)
+        # a wall-clock jump has no representation at all: only the shared
+        # monotonic clock advances staleness
+        mono[0] += 4.9
+        assert mon.stale_ranks() == []      # would be false-stale under a
+        mono[0] += 0.2                      # +1h wall jump with time.time
+        assert mon.stale_ranks() == [0]
+        hb.beat(1)
+        assert mon.stale_ranks() == []
+
+    def test_threshold_is_per_monitor_not_a_constant(self, tmp_path):
+        hb = HeartbeatWriter(str(tmp_path), rank=0)
+        hb.beat(0)
+        time.sleep(0.06)
+        strict = HeartbeatMonitor(str(tmp_path), n_ranks=1, timeout_s=0.05)
+        lax = HeartbeatMonitor(str(tmp_path), n_ranks=1, timeout_s=60.0)
+        assert strict.stale_ranks() == [0]
+        assert lax.stale_ranks() == []
+
+    def test_torn_tmp_file_never_surfaces(self, tmp_path):
+        """A crashed writer's leftover tmp must not shadow the rank file,
+        and a garbage rank file reads as 'no beat yet' (grace), not a
+        crash."""
+        hb = HeartbeatWriter(str(tmp_path), rank=0)
+        hb.beat(3)
+        # a dying predecessor's half-written tmp (pid-unique name)
+        (tmp_path / "rank0.hb.tmp.99999").write_text("2 12")
+        (tmp_path / "rank1.hb").write_text("garbage")
+        mon = HeartbeatMonitor(str(tmp_path), n_ranks=2, timeout_s=60.0)
+        assert mon.read() == {0: (3, mon.read()[0][1])}
+        assert mon.stale_ranks() == []
+
+
+class _FakeGang(Gang):
+    def __init__(self, codes, outs=None):
+        self._codes = codes
+        self._outs = outs
+        self.killed = False
+
+    def poll(self):
+        return list(self._codes)
+
+    def kill(self):
+        self.killed = True
+
+    def outputs(self):
+        return self._outs or [""] * len(self._codes)
+
+
+class _FakeLauncher(Launcher):
+    """Scripted launcher: each launch() pops the next exit-code vector;
+    completed-step sidecars are a plain dict."""
+
+    def __init__(self, world, script, steps=None):
+        self.world_size = world
+        self._script = list(script)
+        self._steps = {} if steps is None else dict(steps)
+        self.plans = []
+        self.gangs = []
+
+    def launch(self, plan):
+        self.plans.append(plan)
+        gang = _FakeGang(self._script.pop(0))
+        self.gangs.append(gang)
+        return gang
+
+    def completed_steps(self, ranks):
+        return {r: self._steps[r] for r in ranks if r in self._steps}
+
+
+def _supervise(launcher, policy, **kw):
+    kw.setdefault("sleep", lambda s: None)
+    return Supervisor(launcher, policy, **kw).run()
+
+
+class TestRestartPolicy:
+    def test_backoff_grows_exponentially_and_caps(self):
+        pol = RestartPolicy(10, backoff_s=1.0, backoff_max_s=4.0,
+                            jitter_frac=0.0)
+        delays = []
+        for _ in range(4):
+            pol.record_failure()
+            delays.append(pol.next_delay())
+        assert delays == [1.0, 2.0, 4.0, 4.0]
+
+    def test_jitter_is_seeded_and_bounded(self):
+        a = RestartPolicy(10, backoff_s=1.0, jitter_frac=0.5,
+                          jitter_seed=7)
+        b = RestartPolicy(10, backoff_s=1.0, jitter_frac=0.5,
+                          jitter_seed=7)
+        a.record_failure(), b.record_failure()
+        da, db = a.next_delay(), b.next_delay()
+        assert da == db                       # reproducible
+        assert 1.0 <= da <= 1.5               # jitter only stretches
+
+    def test_storm_failure_charges_double(self):
+        t = [0.0]
+        pol = RestartPolicy(10, backoff_s=1.0, clock=lambda: t[0])
+        assert pol.record_failure() == 1      # first failure: no storm
+        pol.next_delay()
+        t[0] += 0.5                           # died within the window
+        assert pol.record_failure() == 2
+        t[0] += 1000.0                        # a long healthy run resets
+        assert pol.record_failure() == 1
+        assert (pol.failures, pol.spent, pol.storm_charges) == (3, 4, 1)
+
+    def test_budget_semantics_allow_exactly_max_restarts(self):
+        t = [0.0]
+        pol = RestartPolicy(2, backoff_s=1.0, clock=lambda: t[0])
+        for _ in range(2):
+            t[0] += 1000.0
+            pol.record_failure()
+        assert not pol.exhausted()            # 2 healthy restarts allowed
+        t[0] += 1000.0
+        pol.record_failure()
+        assert pol.exhausted()
+
+    def test_negative_budget_rejected(self):
+        with pytest.raises(ValueError, match="max_restarts"):
+            RestartPolicy(-1)
+
+
+class TestSupervisor:
+    def test_same_size_restart_resumes_from_min_step(self):
+        fl = _FakeLauncher(2, [[0, 9], [0, 0]], steps={0: 3, 1: 2})
+        res = _supervise(fl, RestartPolicy(2, backoff_s=0.001))
+        assert res.outcome == "completed" and res.reason is None
+        assert res.restarts == 1 and res.detected_by == "exit=9"
+        assert fl.plans[1].world_size == 2
+        assert fl.plans[1].resume_step == 2       # gang-wide minimum
+        assert fl.plans[1].restore_ranks is None  # identity at same size
+        assert fl.gangs[0].killed
+
+    def test_restart_is_fresh_when_a_rank_never_checkpointed(self):
+        fl = _FakeLauncher(2, [[17, None], [0, 0]], steps={0: 1})
+        res = _supervise(fl, RestartPolicy(2, backoff_s=0.001))
+        assert res.outcome == "completed"
+        assert fl.plans[1].resume_step is None
+
+    def test_permanent_loss_shrinks_to_surviving_ranks(self):
+        fl = _FakeLauncher(3, [[None, LOSE_RANK_EXIT, None], [0, 0]],
+                           steps={0: 3, 1: 3, 2: 2})
+        res = _supervise(fl, RestartPolicy(2, backoff_s=0.001))
+        assert res.outcome == "completed" and res.shrunk
+        assert res.world_size == 2
+        plan = fl.plans[1]
+        # new rank i restores surviving old rank (0, 2)[i]'s checkpoint,
+        # from the SURVIVORS' minimum (dead rank 1's step 3 is ignored)
+        assert plan.world_size == 2
+        assert plan.restore_ranks == (0, 2)
+        assert plan.resume_step == 2
+
+    def test_permanent_loss_wins_attribution_over_peer_exits(self):
+        # the dying rank's peers often exit non-zero too (torn from the
+        # collective); restarting same-size on a peer's code would miss
+        # the shrink
+        fl = _FakeLauncher(3, [[1, LOSE_RANK_EXIT, 1], [0, 0]],
+                           steps={0: 2, 1: 2, 2: 2})
+        res = _supervise(fl, RestartPolicy(2, backoff_s=0.001))
+        assert res.outcome == "completed"
+        assert res.world_size == 2 and res.shrunk
+        assert res.events[0].rank == 1
+        assert res.events[0].detected_by == f"exit={LOSE_RANK_EXIT}"
+
+    def test_crash_loop_storm_terminates_early(self):
+        """Satellite: a gang whose rank 0 dies at every step (kill-rank@
+        every-step — each relaunch dies ~immediately) burns the budget at
+        DOUBLE rate: max_restarts=4 would allow 4 healthy relaunches, but
+        the storm guard gives up after 3 failures (1+2+2 = 5 > 4)."""
+        fl = _FakeLauncher(2, [[KILL_RANK_EXIT, None]] * 10,
+                           steps={0: 0, 1: 0})
+        res = _supervise(fl, RestartPolicy(4, backoff_s=0.001))
+        assert res.outcome == "gave_up"
+        assert len(fl.plans) == 3            # not 5
+        assert res.budget_spent == 5 and res.storm_charges == 2
+        assert "storm" in res.reason and "budget exhausted" in res.reason
+
+    def test_shrink_below_min_world_gives_up_with_reason(self):
+        fl = _FakeLauncher(2, [[None, LOSE_RANK_EXIT]], steps={0: 2, 1: 2})
+        res = _supervise(fl, RestartPolicy(5, backoff_s=0.001),
+                         min_world=2)
+        assert res.outcome == "gave_up"
+        assert "min_world=2" in res.reason and "permanently lost" \
+            in res.reason
+
+    def test_zero_budget_reports_first_failure(self):
+        fl = _FakeLauncher(2, [[17, None]], steps={})
+        res = _supervise(fl, RestartPolicy(0, backoff_s=0.001))
+        assert res.outcome == "gave_up" and "max_restarts=0" in res.reason
+
+    def test_deadline_raises_supervisor_timeout(self):
+        fl = _FakeLauncher(2, [[None, None]] * 10)
+        with pytest.raises(SupervisorTimeout, match="deadline"):
+            _supervise(fl, RestartPolicy(2, backoff_s=0.001),
+                       deadline_s=0.05, poll_interval_s=0.01)
+        assert fl.gangs[0].killed
+
+    def test_heartbeat_detection_via_monitor_factory(self, tmp_path):
+        class Mon:
+            timeout_s = 1.0
+
+            def __init__(self):
+                self.calls = 0
+
+            def stale_ranks(self):
+                self.calls += 1
+                return [1] if self.calls > 1 else []
+
+        mons = []
+
+        def factory(world):
+            mons.append(Mon())
+            return mons[-1]
+
+        fl = _FakeLauncher(2, [[None, None], [0, 0]], steps={0: 2, 1: 2})
+        res = _supervise(fl, RestartPolicy(2, backoff_s=0.001),
+                         monitor_factory=factory, poll_interval_s=0.0)
+        assert res.outcome == "completed"
+        assert res.events[0].detected_by == "heartbeat>1.0s"
+        assert len(mons) == 2                # fresh monitor per launch
 
 
 class TestNaNGradRollback:
@@ -227,6 +466,37 @@ class TestCorruptCheckpointFallback:
         with pytest.raises(FileNotFoundError):
             corrupt_checkpoint(str(tmp_path), 123)
 
+    def test_checksum_precheck_catches_corruption_cheaply(self, tmp_path,
+                                                          capsys):
+        """Satellite: the crc32 sidecar rejects the truncated step BEFORE
+        orbax ever deserializes it — the fallback log names the checksum
+        error, not a deep deserialization failure."""
+        exp, ck = self._two_step_store(tmp_path)
+        steps = ck.all_steps()
+        corrupt_checkpoint(ck.directory, steps[-1])
+        Experiment.build(SMALL).restore_checkpoint(ck)
+        assert ck.last_restored_step == steps[-2]
+        err = capsys.readouterr().err
+        assert "CheckpointChecksumError" in err
+        assert "crc32 mismatch" in err
+        ck.close()
+
+    def test_corruption_past_the_checksum_still_falls_back(self, tmp_path,
+                                                           capsys):
+        """Satellite: corruption that keeps the sidecar consistent
+        (``fix_checksums=True`` re-checksums the truncated files) slips
+        past the cheap pre-check — the deep failed-load fallback must
+        still land on the previous step."""
+        exp, ck = self._two_step_store(tmp_path)
+        steps = ck.all_steps()
+        corrupt_checkpoint(ck.directory, steps[-1], fix_checksums=True)
+        Experiment.build(SMALL).restore_checkpoint(ck)
+        assert ck.last_restored_step == steps[-2]
+        err = capsys.readouterr().err
+        assert "falling back to step" in err
+        assert "CheckpointChecksumError" not in err
+        ck.close()
+
 
 class TestPBTDeadMembers:
     def _hp(self, n):
@@ -315,6 +585,11 @@ class TestResilienceCLI:
         with pytest.raises(SystemExit, match="multihost"):
             train_cli.main(["--config", "ppo-mlp-synth64", *CLI_FAST,
                             "--fault", "kill-rank@1:rank=0"])
+
+    def test_lose_rank_refused_by_single_process_cli(self):
+        with pytest.raises(SystemExit, match="multihost"):
+            train_cli.main(["--config", "ppo-mlp-synth64", *CLI_FAST,
+                            "--fault", "lose-rank@1:rank=0"])
 
     def test_bad_fault_spec_exits_with_message(self):
         with pytest.raises(SystemExit, match="fault"):
