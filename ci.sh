@@ -1,5 +1,8 @@
 #!/usr/bin/env bash
-# CI pipeline (PR 3): lint stage, then the tier-1 pytest gate.
+# CI pipeline: lint stage (PR 3), the observability smoke stage
+# (ISSUE 5: a telemetry-instrumented 3-iteration run must produce a
+# reportable merged timeline with zero post-warmup alarms), then the
+# tier-1 pytest gate.
 #
 # Stage 1 — lint (fast, no JAX import for jsan's AST pass):
 #   1a. jsan: the repo's JAX-pitfall static analyzer. Scope is the
@@ -42,6 +45,22 @@ if command -v mypy >/dev/null 2>&1; then
 else
     echo "SKIP: mypy not installed (pinned mypy==1.11.2 in pyproject.toml)"
 fi
+
+echo "=== smoke: observability (3-iter CPU run + merged-timeline report) ==="
+# A short geometry-stable training run with the full telemetry layer on
+# must (a) produce a timeline the report CLI accepts and (b) fire ZERO
+# recompile/transfer alarms after warmup — --strict-alarms asserts both
+# in one exit code (ISSUE 5 acceptance).
+OBS_DIR=$(mktemp -d /tmp/ci_obs.XXXXXX)
+trap 'rm -rf "$OBS_DIR"' EXIT
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.train --config ppo-mlp-synth64 \
+    --iterations 3 --n-envs 4 --n-nodes 2 --gpus-per-node 4 \
+    --window-jobs 16 --horizon 64 --queue-len 4 --n-steps 8 \
+    --n-epochs 1 --n-minibatches 2 --log-every 1 \
+    --obs-dir "$OBS_DIR" --alarms > /dev/null
+timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.obs.report "$OBS_DIR" --strict-alarms
 
 echo "=== tier-1 pytest gate 1/2: main pass (ROADMAP.md, minus spawn) ==="
 rm -f /tmp/_t1.log

@@ -165,12 +165,21 @@ class Checkpointer:
     >>> state, key, meta = ckpt.restore(train_state, key)
     """
 
-    def __init__(self, directory: str, max_to_keep: int | None = 3):
+    def __init__(self, directory: str, max_to_keep: int | None = 3,
+                 bus=None):
         self._mngr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True))
         self.last_restored_step: int | None = None
+        # obs.EventBus (or None): save/restore/fallback/crc-reject events
+        # land on the run's timeline so a post-mortem ties a rollback to
+        # the exact step it restored and why the newer ones were rejected
+        self._bus = bus
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self._bus is not None:
+            self._bus.emit(kind, **fields)
 
     @property
     def directory(self) -> str:
@@ -221,6 +230,7 @@ class Checkpointer:
             return False
         if force:
             self.wait()
+        self._emit("ckpt_save", step=step, force=force, saved=bool(saved))
         return bool(saved)
 
     def restore(self, template_state: TrainState,
@@ -283,9 +293,17 @@ class Checkpointer:
                 self._verify_checksums(s)
                 restored = self._mngr.restore(s, args=build_args())
                 self.last_restored_step = s
+                self._emit("ckpt_restore", step=s,
+                           fallback_from=(candidates[0] if i else None),
+                           rejected=len(errors))
                 return restored
             except Exception as e:   # orbax surfaces corruption as
                 errors.append((s, e))  # assorted exception types
+                self._emit("ckpt_crc_reject"
+                           if isinstance(e, CheckpointChecksumError)
+                           else "ckpt_reject",
+                           step=s, error=type(e).__name__,
+                           detail=str(e)[:200])
                 if step is not None or not fallback:
                     raise
                 if i + 1 < len(candidates):
@@ -403,6 +421,9 @@ class Checkpointer:
                     f"shrunk env batch {new_n_envs} not divisible by the "
                     f"surviving mesh's data axis ({n_data})")
             state = dp.put_global(state, replicated(mesh))
+        self._emit("ckpt_elastic_restore", step=self.last_restored_step,
+                   old_world=old_world, surviving_ranks=surv,
+                   new_n_envs=new_n_envs)
         return state, tree.get("key"), extra, dict(restored["meta"] or {})
 
     def read_meta(self, step: int | None = None) -> dict:

@@ -5,6 +5,7 @@ make vectorized envs, build policy, run the trainer loop, log metrics.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from typing import Any, Callable
@@ -382,9 +383,19 @@ class Experiment:
             eval_every: int = 0,
             eval_fn: "Callable[[int], dict] | None" = None,
             eval_logger: Callable[[int, dict], None] | None = None,
-            fused_chunk: int = 1, watchdog=None, injector=None) -> dict:
+            fused_chunk: int = 1, watchdog=None, injector=None,
+            telemetry=None) -> dict:
         """Run the host training loop; returns summary metrics. Pass a
         ``checkpoint.Checkpointer`` + cadence to persist while training.
+
+        ``telemetry`` (:class:`obs.RunTelemetry`) span-traces the loop:
+        per-iteration phase breakdown (step dispatch / sync / eval /
+        ckpt / resample via its ``SectionTimer``), an ``iteration``
+        event at every LOGGED iteration carrying the metrics dict this
+        loop already materialized — telemetry adds ZERO host syncs of
+        its own — and, when its alarms are armed, the jitted dispatch
+        runs under the recompile/transfer production alarms (a rollback
+        retry's LR-rescale re-trace is granted amnesty).
 
         ``eval_fn(i) -> dict`` runs every ``eval_every`` iterations (and at
         the last one) — the in-training quality probe (e.g. a held-out JCT
@@ -437,8 +448,21 @@ class Experiment:
                     f"cadence and the iteration count; offending: {bad}")
         history = []
         eval_history = []
-        t0 = time.time()
+        t0 = time.monotonic()
         stride = fused_chunk if fused_chunk > 1 else 1
+        # telemetry spans: with no telemetry attached, a throwaway timer
+        # keeps the section sites branch-free (its cost is two
+        # perf_counter reads per section — noise next to a dispatch)
+        from .utils.profiling import SectionTimer
+        sections = (telemetry.sections if telemetry is not None
+                    else SectionTimer())
+        if telemetry is not None:
+            telemetry.run_start(
+                loop="experiment", config=self.cfg.name,
+                algo=self.cfg.algo, iterations=iterations,
+                n_envs=self.cfg.n_envs,
+                steps_per_iteration=self.steps_per_iteration,
+                fused_chunk=fused_chunk)
         if watchdog is not None and ckpt.latest_step() is None:
             # guarantee a rollback target before the first periodic save
             self.save_checkpoint(ckpt, meta={"iteration": -1})
@@ -449,30 +473,44 @@ class Experiment:
             # cadence form (b % L == 0) would never fire there; the (b+1)
             # form is the same cadence shifted to boundary-aligned phase
             b = i + stride - 1
+            if telemetry is not None:
+                telemetry.begin_iteration(b)
+            guard = (telemetry.dispatch(b) if telemetry is not None
+                     else contextlib.nullcontext())
+            # "step" is the async dispatch only — the device work it
+            # enqueues materializes in the "sync" span's device_get
             if fused_chunk > 1:
-                metrics = self.run_fused(fused_chunk)
+                with sections("step"), guard:
+                    metrics = self.run_fused(fused_chunk)
             else:
                 self.key, sub = jax.random.split(self.key)
-                self.train_state, self.carry, metrics = self.train_step(
-                    self.train_state, self.carry, self.traces, sub)
+                with sections("step"), guard:
+                    self.train_state, self.carry, metrics = self.train_step(
+                        self.train_state, self.carry, self.traces, sub)
             if injector is not None:
                 metrics = injector.poison_nan(self, b, metrics)
             log_hit = log_every and (
                 (b + 1) % log_every == 0 if fused_chunk > 1
                 else b % log_every == 0)
             want_log = bool(log_every) and (log_hit or b == iterations - 1)
-            # host consumers (watchdog + logger) share ONE batched
-            # device_get: per-field float() is a separate blocking
-            # transfer each, and the watchdog path pays it every
+            # host consumers (watchdog + logger + telemetry) share ONE
+            # batched device_get: per-field float() is a separate
+            # blocking transfer each, and the watchdog path pays it every
             # iteration (jsan host-sync review, PR 3)
             m = None
             if watchdog is not None or want_log:
-                m = {k: float(v) for k, v in
-                     jax.device_get(metrics)._asdict().items()}
+                with sections("sync"):
+                    m = {k: float(v) for k, v in
+                         jax.device_get(metrics)._asdict().items()}
             if watchdog is not None:
                 reason = watchdog.check(m)
                 if reason is not None:
                     event = watchdog.rollback(self, ckpt, b, reason)
+                    if telemetry is not None:
+                        # the retry's LR rescale rebinds tx and re-traces
+                        # the step — a legitimate compile, not an alarm
+                        telemetry.iteration_aborted(
+                            b, f"rollback: {reason}")
                     i = event.resume_iteration
                     continue
             if want_log:
@@ -481,22 +519,29 @@ class Experiment:
                     logger(b, m)
             if eval_fn is not None and eval_every and \
                     ((b + 1) % eval_every == 0 or b == iterations - 1):
-                em = dict(eval_fn(b))
+                with sections("eval"):
+                    em = dict(eval_fn(b))
                 eval_history.append({"iteration": b, **em})
                 if eval_logger is not None:
                     eval_logger(b, em)
             if ckpt is not None and ckpt_every and \
                     ((b + 1) % ckpt_every == 0 or b == iterations - 1):
-                self.save_checkpoint(ckpt, meta={"iteration": b})
+                with sections("ckpt"):
+                    self.save_checkpoint(ckpt, meta={"iteration": b})
                 if injector is not None:
                     injector.corrupt_after_save(ckpt, b)
             if self.cfg.resample_every and \
                     (b + 1) % self.cfg.resample_every == 0 and \
                     b != iterations - 1:
-                self.advance_windows()
+                with sections("resample"):
+                    self.advance_windows()
+            if telemetry is not None:
+                telemetry.end_iteration(
+                    b, m if want_log else None,
+                    stride * self.steps_per_iteration)
             i += stride
         jax.block_until_ready(self.train_state.params)
-        wall = time.time() - t0
+        wall = time.monotonic() - t0
         total_env_steps = iterations * self.steps_per_iteration
         out = {"wall_s": wall, "iterations": iterations,
                "env_steps": total_env_steps,
@@ -508,6 +553,13 @@ class Experiment:
             out["rollback_events"] = [e.as_dict() for e in watchdog.events]
         if eval_history:
             out["eval_history"] = eval_history
+        if telemetry is not None:
+            telemetry.run_end(
+                iterations=iterations, wall_s=round(wall, 6),
+                env_steps=total_env_steps,
+                env_steps_per_sec=round(out["env_steps_per_sec"], 3),
+                rollbacks=(watchdog.n_rollbacks
+                           if watchdog is not None else 0))
         return out
 
 
@@ -677,7 +729,7 @@ class PopulationExperiment:
             eval_every: int = 0,
             eval_fn: "Callable[[int], dict] | None" = None,
             eval_logger: Callable[[int, dict], None] | None = None,
-            watchdog=None, injector=None) -> dict:
+            watchdog=None, injector=None, telemetry=None) -> dict:
         """Train the population; PBT exploit/explore fires every
         ``controller.cfg.ready_iters`` iterations. Returns summary metrics
         including per-member final fitness and the PBT event log.
@@ -705,15 +757,30 @@ class PopulationExperiment:
         split_all = jax.jit(jax.vmap(lambda k: jax.random.split(k)))
         history = []
         eval_history = []
-        t0 = time.time()
+        t0 = time.monotonic()
+        from .utils.profiling import SectionTimer
+        sections = (telemetry.sections if telemetry is not None
+                    else SectionTimer())
+        if telemetry is not None:
+            telemetry.run_start(
+                loop="population", config=self.cfg.name,
+                n_pop=self.n_pop, iterations=iterations,
+                n_envs=self.cfg.n_envs,
+                steps_per_iteration=self.steps_per_iteration)
         if watchdog is not None and ckpt.latest_step() is None:
             self.save_checkpoint(ckpt, meta={"iteration": -1})
         i = 0
         while i < iterations:
+            if telemetry is not None:
+                telemetry.begin_iteration(i)
+            guard = (telemetry.dispatch(i) if telemetry is not None
+                     else contextlib.nullcontext())
             both = split_all(self.keys)
             self.keys, subs = both[:, 0], both[:, 1]
-            self.states, self.carries, metrics = self.pop_step(
-                self.states, self.carries, self.traces, subs, self.hparams)
+            with sections("step"), guard:
+                self.states, self.carries, metrics = self.pop_step(
+                    self.states, self.carries, self.traces, subs,
+                    self.hparams)
             if injector is not None:
                 metrics = injector.poison_nan_member(self, i, metrics)
             fitness = metrics.mean_reward
@@ -721,12 +788,21 @@ class PopulationExperiment:
                 reason = watchdog.check_population(fitness)
                 if reason is not None:
                     event = watchdog.rollback(self, ckpt, i, reason)
+                    if telemetry is not None:
+                        telemetry.iteration_aborted(
+                            i, f"rollback: {reason}")
                     i = event.resume_iteration
                     continue
             self.controller.record(fitness)
             out = self.controller.maybe_update(i, self.states, self.hparams)
             if out is not None:
-                self.states, self.hparams, _decision = out
+                self.states, self.hparams, decision = out
+                if telemetry is not None:
+                    telemetry.emit(
+                        "pbt_exploit", iteration=i,
+                        exploited=int(decision.exploited.sum()),
+                        src=[int(s) for s in decision.src])
+            m = None
             if log_every and (i % log_every == 0 or i == iterations - 1):
                 # flatten per-member values to suffixed scalar columns so
                 # the CSV stays pandas/TensorBoard-ingestible (ADVICE r1).
@@ -734,7 +810,9 @@ class PopulationExperiment:
                 # per-element float() was n_fields x P separate blocking
                 # transfers per logged iteration (jsan host-sync review)
                 m = {}
-                for k, v in jax.device_get(metrics)._asdict().items():
+                with sections("sync"):
+                    got = jax.device_get(metrics)._asdict()
+                for k, v in got.items():
                     vals = [float(x) for x in v]
                     m.update({f"{k}_{p}": x for p, x in enumerate(vals)})
                     m[f"{k}_mean"] = sum(vals) / len(vals)
@@ -743,18 +821,22 @@ class PopulationExperiment:
                     logger(i, m)
             if eval_fn is not None and eval_every and \
                     ((i + 1) % eval_every == 0 or i == iterations - 1):
-                em = dict(eval_fn(i))
+                with sections("eval"):
+                    em = dict(eval_fn(i))
                 eval_history.append({"iteration": i, **em})
                 if eval_logger is not None:
                     eval_logger(i, em)
             if ckpt is not None and ckpt_every and \
                     ((i + 1) % ckpt_every == 0 or i == iterations - 1):
-                self.save_checkpoint(ckpt, meta={"iteration": i})
+                with sections("ckpt"):
+                    self.save_checkpoint(ckpt, meta={"iteration": i})
                 if injector is not None:
                     injector.corrupt_after_save(ckpt, i)
+            if telemetry is not None:
+                telemetry.end_iteration(i, m, self.steps_per_iteration)
             i += 1
         jax.block_until_ready(self.states.params)
-        wall = time.time() - t0
+        wall = time.monotonic() - t0
         total_env_steps = iterations * self.steps_per_iteration
         out = {"wall_s": wall, "iterations": iterations,
                "env_steps": total_env_steps,
@@ -768,4 +850,12 @@ class PopulationExperiment:
             out["rollback_events"] = [e.as_dict() for e in watchdog.events]
         if eval_history:
             out["eval_history"] = eval_history
+        if telemetry is not None:
+            telemetry.run_end(
+                iterations=iterations, wall_s=round(wall, 6),
+                env_steps=total_env_steps,
+                env_steps_per_sec=round(out["env_steps_per_sec"], 3),
+                pbt_events=len(self.controller.history),
+                rollbacks=(watchdog.n_rollbacks
+                           if watchdog is not None else 0))
         return out

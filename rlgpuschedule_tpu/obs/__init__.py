@@ -1,0 +1,51 @@
+"""Unified telemetry layer (L6 aux): event bus, metrics registry,
+run-loop spans, production alarms, multihost merge + post-mortem.
+
+Podracer's core observability argument — scalable RL stacks live or die
+by cheap, always-on throughput/health telemetry — applied to this
+codebase's production machinery: when a multihost run restarts, rolls
+back, or silently recompiles, this package is what ties *what happened*
+to *when and on which rank*.
+
+- :mod:`.events` — the structured event bus: append-only JSONL, one
+  stream per rank, every event stamped ``(v, kind, rank, pid, seq,
+  mono, wall)``; reader tolerates a crashed writer's torn last line;
+  :func:`merge_dir` orders interleaved per-rank streams into one
+  timeline by the shared monotonic clock.
+- :mod:`.metrics` — counters/gauges registry with an atomic
+  Prometheus-text snapshot file (``metrics.prom``).
+- :mod:`.telemetry` — :class:`RunTelemetry` (what ``Experiment.run`` /
+  ``PopulationExperiment.run`` hold: iteration spans with a
+  rollout+update/sync/eval/ckpt phase breakdown, zero added host syncs)
+  and :class:`Alarms` (``CompileCounter`` + transfer-guard promoted
+  from test-only sentinels to production: ``recompile``/``transfer``
+  events, optional slow-iteration ``jax.profiler`` auto-capture).
+- :mod:`.report` — ``python -m rlgpuschedule_tpu.obs.report <dir>``:
+  merged timeline post-mortem (phase-time table, restart/rollback
+  history, steps/s curve, alarm summary; ``--strict-alarms`` for CI).
+
+Event kinds by emitter:
+
+== run loops (``experiment.py``): ``run_start``, ``iteration``,
+   ``run_end``, ``pbt_exploit``
+== alarms: ``compile`` (warmup/expected), ``recompile``, ``transfer``,
+   ``slow_iteration``, ``profile_captured``
+== checkpoint: ``ckpt_save``, ``ckpt_restore``, ``ckpt_reject``,
+   ``ckpt_crc_reject``, ``ckpt_elastic_restore``
+== resilience: ``rollback`` (watchdog), ``fault`` (injector)
+== supervisor: ``gang_launch``, ``rank_failure``, ``gang_restart``,
+   ``gang_shrink``, ``supervisor_done``
+== multihost worker: ``worker_start``, ``worker_resumed``,
+   ``worker_step``, ``worker_done``
+"""
+from .events import (EventBus, SCHEMA_VERSION, event_streams, merge_dir,
+                     merge_events, read_events)
+from .metrics import Counter, Gauge, Registry
+from .telemetry import AlarmError, Alarms, RunTelemetry
+
+__all__ = [
+    "EventBus", "SCHEMA_VERSION", "event_streams", "merge_dir",
+    "merge_events", "read_events",
+    "Counter", "Gauge", "Registry",
+    "AlarmError", "Alarms", "RunTelemetry",
+]

@@ -1,0 +1,107 @@
+"""Counters/gauges registry with a Prometheus-style text snapshot.
+
+The event bus answers "what happened, when"; this registry answers "how
+much, right now" — monotonically increasing counters (iterations run,
+recompile alarms fired) and point-in-time gauges (steps/s). The snapshot
+is the Prometheus *text exposition format* written to a file, not an
+HTTP endpoint: training hosts usually can't open ports, but every fleet
+scraper (node-exporter textfile collector, a sidecar, plain ``cat``)
+can read a file, and the format is the observability lingua franca.
+
+Dependency-free by the same argument as the hand-rolled TensorBoard
+writer in ``utils.logging``: the write cadence is one small file per
+logged iteration, so a client library would buy nothing.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Union
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+class Counter:
+    """Monotonically increasing value. ``inc`` refuses negative deltas —
+    a decreasing counter corrupts every rate() computed from it."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name}: negative increment {n}")
+        self.value += n
+
+
+class Gauge:
+    """Point-in-time value; may move in either direction."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Registry:
+    """Flat name -> metric registry.
+
+    Re-registering an existing name returns the SAME object (call sites
+    in different subsystems may race to declare a shared metric), but a
+    kind mismatch raises — silently returning a counter where a gauge
+    was requested corrupts the snapshot's TYPE line.
+    """
+
+    def __init__(self):
+        self._metrics: dict[str, Union[Counter, Gauge]] = {}
+
+    def _register(self, cls, name: str, help: str):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"bad metric name {name!r} (want "
+                             f"{_NAME_RE.pattern})")
+        existing = self._metrics.get(name)
+        if existing is not None:
+            if not isinstance(existing, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{existing.kind}, not {cls.kind}")
+            return existing
+        metric = cls(name, help)
+        self._metrics[name] = metric
+        return metric
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._register(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._register(Gauge, name, help)
+
+    def render(self) -> str:
+        """Prometheus text exposition: ``# HELP`` / ``# TYPE`` / value
+        lines, name-sorted for a stable diffable snapshot."""
+        lines = []
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            lines.append(f"{name} {m.value:g}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write(self, path: str) -> None:
+        """Atomically replace the snapshot file (a scraper must never
+        read a half-written exposition)."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(self.render())
+        os.replace(tmp, path)

@@ -1,0 +1,174 @@
+"""Multihost merge + run post-mortem CLI.
+
+``python -m rlgpuschedule_tpu.obs.report <obs-dir>`` merges every
+per-rank event stream under ``<obs-dir>`` into one monotonic-ordered
+timeline and prints the run's post-mortem:
+
+- header: schema versions, emitting ranks, event count, time span;
+- phase-time table (host wall seconds per run-loop phase, from the
+  ``iteration`` spans);
+- restart / rollback history: supervisor launch→failure→relaunch
+  decisions, watchdog rollbacks, checkpoint save/restore/reject events
+  and fault injections, in timeline order;
+- steps/s curve (one row per logged iteration);
+- alarm summary (``recompile`` / ``transfer`` / ``slow_iteration``).
+
+Exit codes: 0 ok, 1 no events under the directory (an empty post-mortem
+must fail loudly), 2 usage. ``--strict-alarms`` additionally exits 1
+when any post-warmup alarm event fired — the CI hook: a geometry-stable
+smoke run must produce a merged timeline with ZERO ``recompile`` events
+(ci.sh smoke stage).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .events import merge_dir
+
+# event kinds that are production alarms (Alarms emissions; ``compile``
+# is the blessed warmup/amnesty record, not an alarm)
+ALARM_KINDS = ("recompile", "transfer", "slow_iteration")
+
+# the restart/rollback/fault story, in one timeline
+_HISTORY_KINDS = (
+    "gang_launch", "rank_failure", "gang_restart", "gang_shrink",
+    "supervisor_done", "rollback", "fault", "ckpt_reject",
+    "ckpt_crc_reject", "ckpt_elastic_restore", "worker_resumed",
+)
+
+
+def build_report(events: list[dict]) -> dict:
+    """Aggregate a merged timeline into the post-mortem's sections."""
+    ranks = sorted({e.get("rank", 0) for e in events})
+    versions = sorted({e.get("v", 0) for e in events})
+    monos = [e["mono"] for e in events if "mono" in e]
+    span_s = (max(monos) - min(monos)) if monos else 0.0
+    t0 = min(monos) if monos else 0.0
+
+    phases: dict[str, float] = {}
+    curve = []
+    for e in events:
+        if e.get("kind") != "iteration":
+            continue
+        for phase, secs in (e.get("phases") or {}).items():
+            phases[phase] = phases.get(phase, 0.0) + secs
+        curve.append({"iteration": e.get("iteration"),
+                      "rank": e.get("rank", 0),
+                      "steps_per_sec": e.get("steps_per_sec"),
+                      "wall_s": e.get("wall_s")})
+
+    history = [e for e in events if e.get("kind") in _HISTORY_KINDS]
+    restores = [e for e in events if e.get("kind") == "ckpt_restore"]
+    alarms = {k: sum(1 for e in events if e.get("kind") == k)
+              for k in ALARM_KINDS}
+    counts: dict[str, int] = {}
+    for e in events:
+        k = str(e.get("kind"))
+        counts[k] = counts.get(k, 0) + 1
+    return {"schema_versions": versions, "ranks": ranks,
+            "n_events": len(events), "span_s": span_s, "t0_mono": t0,
+            "phase_seconds": phases, "steps_curve": curve,
+            "history": history, "ckpt_restores": restores,
+            "alarms": alarms, "kind_counts": counts}
+
+
+def _fmt_history_line(e: dict, t0: float) -> str:
+    t = e.get("mono", t0) - t0
+    rank = e.get("rank", "?")
+    detail = {k: v for k, v in e.items()
+              if k not in ("v", "kind", "rank", "pid", "seq", "mono",
+                           "wall")}
+    body = " ".join(f"{k}={v}" for k, v in sorted(detail.items())
+                    if v is not None)
+    return f"  +{t:9.3f}s  rank {rank:>3}  {e.get('kind'):<22s} {body}"
+
+
+def format_report(rep: dict) -> str:
+    """The human post-mortem. Sections keyed to build_report's dict."""
+    lines = [
+        f"run post-mortem: {rep['n_events']} events from "
+        f"{len(rep['ranks'])} emitter(s) (ranks {rep['ranks']}), "
+        f"schema v{rep['schema_versions']}, span {rep['span_s']:.3f}s",
+        "",
+    ]
+    if rep["phase_seconds"]:
+        total = sum(rep["phase_seconds"].values()) or 1.0
+        lines.append("phase-time table (host wall, from iteration spans):")
+        lines.append(f"  {'phase':<12s} {'seconds':>10s} {'share':>7s}")
+        for phase, secs in sorted(rep["phase_seconds"].items(),
+                                  key=lambda kv: -kv[1]):
+            lines.append(f"  {phase:<12s} {secs:>10.3f} "
+                         f"{100.0 * secs / total:>6.1f}%")
+        lines.append("")
+    if rep["history"]:
+        lines.append("restart / rollback / fault history:")
+        for e in rep["history"]:
+            lines.append(_fmt_history_line(e, rep["t0_mono"]))
+        lines.append("")
+    if rep["steps_curve"]:
+        lines.append("steps/s curve (logged iterations):")
+        lines.append(f"  {'iter':>6s} {'rank':>4s} {'steps/s':>12s} "
+                     f"{'iter wall s':>12s}")
+        for row in rep["steps_curve"]:
+            sps = row.get("steps_per_sec")
+            wall = row.get("wall_s")
+            lines.append(
+                f"  {row.get('iteration', '?'):>6} "
+                f"{row.get('rank', 0):>4} "
+                f"{(f'{sps:.1f}' if sps is not None else '?'):>12s} "
+                f"{(f'{wall:.4f}' if wall is not None else '?'):>12s}")
+        lines.append("")
+    alarm_total = sum(rep["alarms"].values())
+    lines.append(
+        "alarms: " + ", ".join(f"{k}={n}"
+                               for k, n in sorted(rep["alarms"].items()))
+        + ("" if alarm_total else "  (clean)"))
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="rlgpuschedule_tpu.obs.report",
+        description="Merge per-rank event streams into one timeline and "
+                    "print a run post-mortem.")
+    p.add_argument("obs_dir", help="directory holding events.*.jsonl "
+                                   "streams (--obs-dir of the run)")
+    p.add_argument("--json", action="store_true",
+                   help="print the structured report as JSON instead of "
+                        "the human tables")
+    p.add_argument("--out", default=None,
+                   help="also write the merged ordered timeline to this "
+                        "JSONL file")
+    p.add_argument("--strict-alarms", action="store_true",
+                   help="exit 1 if any post-warmup alarm event "
+                        f"({'/'.join(ALARM_KINDS)}) fired")
+    args = p.parse_args(argv)
+    try:
+        events = merge_dir(args.obs_dir)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    if not events:
+        print(f"event streams under {args.obs_dir} hold no decodable "
+              f"events", file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            for e in events:
+                f.write(json.dumps(e, sort_keys=True) + "\n")
+    rep = build_report(events)
+    if args.json:
+        print(json.dumps(rep, sort_keys=True))
+    else:
+        print(format_report(rep))
+    if args.strict_alarms and sum(rep["alarms"].values()) > 0:
+        print(f"strict-alarms: {rep['alarms']} alarm event(s) in the "
+              f"timeline", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,315 @@
+"""Run-loop telemetry: iteration spans + production alarms.
+
+:class:`RunTelemetry` is what the train loops hold — one object owning
+the event bus (:mod:`.events`), the counters/gauges registry
+(:mod:`.metrics`), the host-side phase timer
+(``utils.profiling.SectionTimer``) and, opt-in, the :class:`Alarms`.
+
+Host-sync discipline (the whole design constraint): telemetry never
+touches device values. Phase timings are host clocks; the ``iteration``
+event is emitted only at logged iterations, carrying the metrics dict
+the run loop ALREADY materialized through its single batched
+``device_get`` — so an instrumented run performs exactly the same
+host↔device syncs as a bare one (asserted in tests/test_obs.py).
+
+:class:`Alarms` promotes PR 3's test-only sentinels to production:
+
+- **recompile** — a ``CompileCounter`` (jax.monitoring listeners) spans
+  the run; any trace/compile activity observed during a post-warmup
+  dispatch emits a ``recompile`` event and bumps a counter instead of
+  only failing a sanitize test. Legitimate re-traces (warmup, the
+  watchdog's LR-rescale rollback) are granted amnesty via
+  :meth:`Alarms.expect_recompile` and land as ``compile`` events.
+- **transfer** — post-warmup dispatches run under
+  ``jax.transfer_guard("disallow")``: an implicit host↔device transfer
+  in the hot path emits a ``transfer`` event and raises
+  :class:`AlarmError` (fail fast WITH telemetry — the buffer-donating
+  dispatch cannot be safely retried after a mid-trace abort).
+- **slow_iteration** — optionally, an iteration whose wall time exceeds
+  ``slow_iter_s`` emits the event and arms a one-shot ``jax.profiler``
+  trace capture of the NEXT iteration (profiling the slow iteration
+  itself is impossible — it already happened).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Callable, Iterator, Mapping
+
+from ..analysis.sentinels import CompileCounter, no_implicit_transfers
+from ..utils.profiling import SectionTimer
+from .events import EventBus
+from .metrics import Registry
+
+PROM_SNAPSHOT = "metrics.prom"
+
+
+class AlarmError(RuntimeError):
+    """A production alarm that cannot be survived in place (an implicit
+    transfer inside a buffer-donating dispatch)."""
+
+
+class Alarms:
+    """Production alarm scope. Use as a context manager spanning the run;
+    wrap each jitted dispatch in :meth:`dispatch`.
+
+    ``warmup_iters`` dispatches are exempt (the first iteration MUST
+    compile); compile activity inside them is still recorded, as
+    ``compile`` events, so the post-mortem shows where compile time
+    went. ``expect_recompile(reason)`` grants the next dispatch the same
+    amnesty — the run loop calls it after a watchdog rollback, whose LR
+    rescale legitimately re-traces the step.
+    """
+
+    def __init__(self, bus: EventBus, registry: Registry | None = None,
+                 warmup_iters: int = 1, transfer_guard: bool = True,
+                 slow_iter_s: float | None = None,
+                 profile_dir: str | None = None):
+        if warmup_iters < 0:
+            raise ValueError(f"warmup_iters must be >= 0, got "
+                             f"{warmup_iters}")
+        self.bus = bus
+        self.registry = registry if registry is not None else Registry()
+        self.warmup_iters = warmup_iters
+        self.transfer_guard = transfer_guard
+        self.slow_iter_s = slow_iter_s
+        self.profile_dir = profile_dir
+        self._counter: CompileCounter | None = None
+        self._dispatches = 0
+        self._amnesty: str | None = None
+        self._profile_pending = False
+        self._profile_active = False
+        self._profile_done = False
+        self._recompiles = self.registry.counter(
+            "rlsched_recompile_alarms_total",
+            "post-warmup dispatches that traced or compiled")
+        self._transfers = self.registry.counter(
+            "rlsched_transfer_alarms_total",
+            "implicit host-device transfers caught in the hot path")
+        self._slow = self.registry.counter(
+            "rlsched_slow_iteration_alarms_total",
+            "iterations slower than the slow_iter_s threshold")
+
+    def __enter__(self) -> "Alarms":
+        self._counter = CompileCounter().__enter__()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop_profile()
+        if self._counter is not None:
+            self._counter.__exit__(*exc)
+            self._counter = None
+
+    def expect_recompile(self, reason: str) -> None:
+        """Grant the NEXT dispatch compile amnesty (e.g. a rollback's LR
+        rescale rebinds the optimizer and re-traces legitimately)."""
+        self._amnesty = reason
+
+    @contextlib.contextmanager
+    def dispatch(self, iteration: int) -> Iterator[None]:
+        """Wrap one jitted dispatch: count compile activity attributable
+        to it and (post-warmup) forbid implicit transfers."""
+        if self._counter is None:
+            raise ValueError("Alarms.dispatch outside the context "
+                             "(enter the Alarms scope first)")
+        warm = self._dispatches < self.warmup_iters
+        amnesty, self._amnesty = self._amnesty, None
+        self._dispatches += 1
+        t0 = self._counter.total
+        guard = (no_implicit_transfers()
+                 if self.transfer_guard and not warm and amnesty is None
+                 else contextlib.nullcontext())
+        try:
+            with guard:
+                yield
+        except Exception as e:
+            msg = str(e)
+            if "disallow" in msg.lower() or "transfer" in msg.lower():
+                self._transfers.inc()
+                self.bus.emit("transfer", iteration=iteration,
+                              error=msg[:500])
+                raise AlarmError(
+                    f"implicit host<->device transfer in the iteration-"
+                    f"{iteration} dispatch (transfer alarm): {msg}") from e
+            raise
+        compiles = self._counter.total - t0
+        if compiles <= 0:
+            return
+        if warm or amnesty is not None:
+            self.bus.emit("compile", iteration=iteration, events=compiles,
+                          warmup=warm, expected=amnesty)
+        else:
+            self._recompiles.inc()
+            self.bus.emit("recompile", iteration=iteration,
+                          events=compiles)
+
+    def observe_wall(self, iteration: int, wall_s: float) -> None:
+        """Slow-iteration trigger: emit the alarm and arm a one-shot
+        profiler capture of the next iteration."""
+        if self.slow_iter_s is None or wall_s <= self.slow_iter_s:
+            return
+        self._slow.inc()
+        self.bus.emit("slow_iteration", iteration=iteration,
+                      wall_s=round(wall_s, 6),
+                      threshold_s=self.slow_iter_s)
+        if self.profile_dir is not None and not self._profile_done:
+            self._profile_pending = True
+
+    def maybe_start_profile(self) -> None:
+        if not self._profile_pending or self._profile_active:
+            return
+        import jax
+        jax.profiler.start_trace(self.profile_dir)
+        self._profile_pending = False
+        self._profile_active = True
+
+    def stop_profile(self, iteration: int | None = None) -> None:
+        if not self._profile_active:
+            return
+        import jax
+        jax.profiler.stop_trace()
+        self._profile_active = False
+        self._profile_done = True   # one capture per run
+        self.bus.emit("profile_captured", iteration=iteration,
+                      profile_dir=self.profile_dir)
+
+
+class RunTelemetry:
+    """Everything a run loop needs, in one handle.
+
+    >>> with RunTelemetry(obs_dir, alarms=True) as tel:
+    ...     exp.run(iterations=100, log_every=10, telemetry=tel)
+
+    The loop protocol (``Experiment.run`` / ``PopulationExperiment.run``
+    implement it): ``run_start`` once; per iteration ``begin_iteration``
+    → ``dispatch`` around the jitted call → phase work under
+    ``sections(name)`` → ``end_iteration`` (metrics dict only when the
+    loop materialized one — logged iterations); ``iteration_aborted`` on
+    a rollback retry; ``run_end`` once. Everything is host-side; no
+    device value is ever touched here.
+    """
+
+    def __init__(self, obs_dir: str, rank: int = 0, alarms: bool = False,
+                 warmup_iters: int = 1, transfer_guard: bool = True,
+                 slow_iter_s: float | None = None,
+                 name: str | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.obs_dir = obs_dir
+        self.bus = EventBus(obs_dir, rank=rank, name=name)
+        self.registry = Registry()
+        self.sections = SectionTimer()
+        self._clock = clock
+        self.alarms = (Alarms(self.bus, self.registry,
+                              warmup_iters=warmup_iters,
+                              transfer_guard=transfer_guard,
+                              slow_iter_s=slow_iter_s,
+                              profile_dir=os.path.join(obs_dir, "profile"))
+                       if alarms else None)
+        self._iterations = self.registry.counter(
+            "rlsched_iterations_total", "train iterations completed")
+        self._env_steps = self.registry.counter(
+            "rlsched_env_steps_total", "environment steps completed")
+        self._steps_per_sec = self.registry.gauge(
+            "rlsched_env_steps_per_sec",
+            "cumulative env-steps/sec over the run (monotonic clock)")
+        self._t_run = clock()
+        self._t_iter: float | None = None
+        self._last_sections: dict[str, float] = {}
+        self.prom_path = os.path.join(obs_dir, PROM_SNAPSHOT)
+
+    # -- lifecycle ---------------------------------------------------------
+    def __enter__(self) -> "RunTelemetry":
+        if self.alarms is not None:
+            self.alarms.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self.alarms is not None:
+            self.alarms.__exit__(*exc)
+        self.close()
+
+    def close(self) -> None:
+        self.registry.write(self.prom_path)
+        self.bus.close()
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        self.bus.emit(kind, **fields)
+
+    def run_start(self, **info: Any) -> None:
+        self.bus.emit("run_start", **info)
+
+    def run_end(self, **info: Any) -> None:
+        self.bus.emit("run_end", phase_seconds=self._rounded_sections(),
+                      **info)
+        self.registry.write(self.prom_path)
+
+    # -- per-iteration protocol -------------------------------------------
+    def begin_iteration(self, iteration: int) -> None:
+        self._t_iter = self._clock()
+        if self.alarms is not None:
+            self.alarms.maybe_start_profile()
+
+    @contextlib.contextmanager
+    def dispatch(self, iteration: int) -> Iterator[None]:
+        if self.alarms is None:
+            yield
+            return
+        with self.alarms.dispatch(iteration):
+            yield
+
+    def end_iteration(self, iteration: int,
+                      metrics: Mapping[str, Any] | None = None,
+                      env_steps: int = 0) -> None:
+        """Close the span opened by :meth:`begin_iteration`. ``metrics``
+        is the ALREADY-materialized host dict of a logged iteration (or
+        None between log points — no event, no sync, just bookkeeping)."""
+        wall = (self._clock() - self._t_iter
+                if self._t_iter is not None else 0.0)
+        self._t_iter = None
+        self._iterations.inc()
+        self._env_steps.inc(env_steps)
+        dt = self._clock() - self._t_run
+        if dt > 0:
+            self._steps_per_sec.set(self._env_steps.value / dt)
+        if self.alarms is not None:
+            self.alarms.stop_profile(iteration)
+            self.alarms.observe_wall(iteration, wall)
+        if metrics is None:
+            return
+        self.bus.emit("iteration", iteration=iteration,
+                      wall_s=round(wall, 6), phases=self._section_delta(),
+                      steps_per_sec=round(self._steps_per_sec.value, 3),
+                      metrics={k: v for k, v in metrics.items()})
+        self.registry.write(self.prom_path)
+
+    def iteration_aborted(self, iteration: int, reason: str) -> None:
+        """A rollback retry abandoned this iteration: settle the span
+        without an event (the watchdog emits its own ``rollback``) and
+        grant the retry's re-trace amnesty."""
+        self._t_iter = None
+        if self.alarms is not None:
+            self.alarms.stop_profile(iteration)
+            self.alarms.expect_recompile(reason)
+
+    def expect_recompile(self, reason: str) -> None:
+        if self.alarms is not None:
+            self.alarms.expect_recompile(reason)
+
+    # -- internals ---------------------------------------------------------
+    def _rounded_sections(self) -> dict[str, float]:
+        return {k: round(v, 6) for k, v in self.sections.report().items()}
+
+    def _section_delta(self) -> dict[str, float]:
+        """Per-phase seconds since the previous ``iteration`` event (the
+        span breakdown), from the cumulative SectionTimer."""
+        now = self.sections.report()
+        delta = {k: round(v - self._last_sections.get(k, 0.0), 6)
+                 for k, v in now.items()}
+        self._last_sections = now
+        for phase, secs in delta.items():
+            self.registry.counter(
+                f"rlsched_phase_{phase}_seconds_total",
+                f"host wall seconds spent in the {phase} phase").inc(
+                max(secs, 0.0))
+        return delta

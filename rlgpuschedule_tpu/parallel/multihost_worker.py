@@ -132,6 +132,11 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--fault", action="append", default=None,
                     help="kill-rank@T:rank=R | lose-rank@T:rank=R "
                          "(resilience.parse_fault)")
+    ap.add_argument("--obs-dir", default=None,
+                    help="append this rank's telemetry events "
+                         "(obs.EventBus JSONL stream) under this "
+                         "directory; the supervisor's report CLI merges "
+                         "all ranks into one timeline")
     ap.add_argument("--no-pbt-check", action="store_true",
                     help="skip the PBT exploit-gather section (the "
                          "supervised dryrun tests recovery, not PBT)")
@@ -163,7 +168,18 @@ def main(argv: list[str] | None = None) -> None:
     from rlgpuschedule_tpu.resilience import (FaultInjector, HeartbeatWriter,
                                               parse_fault)
 
-    injector = FaultInjector([parse_fault(s) for s in args.fault or []])
+    bus = None
+    if args.obs_dir:
+        from rlgpuschedule_tpu.obs import EventBus
+        bus = EventBus(args.obs_dir, rank=args.proc_id)
+        bus.emit("worker_start", world=args.num_procs,
+                 devices_per_proc=args.devices_per_proc, steps=args.steps,
+                 resume_step=(args.resume_step
+                              if args.resume_step >= 0 else None),
+                 restore_rank=(args.restore_rank
+                               if args.restore_rank >= 0 else None))
+    injector = FaultInjector([parse_fault(s) for s in args.fault or []],
+                             bus=bus)
     hb = (HeartbeatWriter(args.heartbeat_dir, args.proc_id)
           if args.heartbeat_dir else None)
     if hb is not None:
@@ -254,6 +270,9 @@ def main(argv: list[str] | None = None) -> None:
         start = args.resume_step
         src = args.restore_rank if args.restore_rank >= 0 else args.proc_id
         state = _load_rank_ckpt(args.ckpt_dir, src, state, start)
+        if bus is not None:
+            bus.emit("worker_resumed", step=start, from_rank=src,
+                     world=args.num_procs)
         print(f"MULTIHOST_RESUMED proc={args.proc_id} step={start} "
               f"from_rank={src}", flush=True)
     step, state, carry, traces = dp.shard_train(
@@ -267,12 +286,18 @@ def main(argv: list[str] | None = None) -> None:
         if args.ckpt_dir:
             jax.block_until_ready(state.params)
             _save_rank_ckpt(args.ckpt_dir, args.proc_id, state, i + 1)
+        if bus is not None:
+            bus.emit("worker_step", step=i, completed=i + 1)
     jax.block_until_ready(state.params)
     assert all(bool(jnp.isfinite(v)) for v in metrics), metrics
     # replicated-params fingerprint: identical across ranks iff the
     # cross-process gradient psum worked
     fp = float(sum(jnp.sum(jnp.abs(l.astype(jnp.float32)))
                    for l in jax.tree.leaves(state.params)))
+    if bus is not None:
+        bus.emit("worker_done", world=args.num_procs,
+                 fingerprint=round(fp, 6))
+        bus.close()
     print(f"MULTIHOST_DP_OK proc={args.proc_id} fingerprint={fp:.6f}",
           flush=True)
 

@@ -111,8 +111,14 @@ class FaultInjector:
     matches the current iteration/step, so an attached injector costs
     nothing on the healthy path."""
 
-    def __init__(self, specs: list[FaultSpec]):
+    def __init__(self, specs: list[FaultSpec], bus=None):
         self.specs = list(specs)
+        self._bus = bus   # obs.EventBus (or None): fault firings
+
+    def _emit(self, spec: FaultSpec, **fields: Any) -> None:
+        if self._bus is not None:
+            self._bus.emit("fault", fault=spec.kind, at=spec.at,
+                           target_rank=spec.rank, **fields)
 
     def _take(self, kind: str, at: int) -> FaultSpec | None:
         for s in self.specs:
@@ -127,8 +133,10 @@ class FaultInjector:
         poisoned) metrics NamedTuple."""
         import jax
         import jax.numpy as jnp
-        if self._take("nan-grad", iteration) is None:
+        spec = self._take("nan-grad", iteration)
+        if spec is None:
             return metrics
+        self._emit(spec, iteration=iteration)
         print(f"fault-injection: nan-grad at iteration {iteration} "
               f"(params poisoned)", file=sys.stderr, flush=True)
         exp.train_state = exp.train_state.replace(
@@ -148,6 +156,7 @@ class FaultInjector:
         if spec is None:
             return metrics
         m = spec.rank
+        self._emit(spec, iteration=iteration, member=m)
         print(f"fault-injection: nan-grad at iteration {iteration} "
               f"member {m}", file=sys.stderr, flush=True)
         pop.states = pop.states._replace(
@@ -159,11 +168,13 @@ class FaultInjector:
     def corrupt_after_save(self, ckpt: Any, iteration: int) -> None:
         """``corrupt-ckpt`` hook: right after the periodic save at
         ``iteration``, corrupt the just-saved (latest) step's files."""
-        if self._take("corrupt-ckpt", iteration) is None:
+        spec = self._take("corrupt-ckpt", iteration)
+        if spec is None:
             return
         ckpt.wait()          # the async save must be on disk to corrupt
         step = ckpt.latest_step()
         n = corrupt_checkpoint(ckpt.directory, step)
+        self._emit(spec, iteration=iteration, step=step, files=n)
         print(f"fault-injection: corrupted checkpoint step {step} "
               f"({n} files) after iteration {iteration}",
               file=sys.stderr, flush=True)
@@ -180,6 +191,9 @@ class FaultInjector:
                 s.fired = True
                 code = (KILL_RANK_EXIT if s.kind == "kill-rank"
                         else LOSE_RANK_EXIT)
+                # the bus appends+flushes per emit, so the event is
+                # durable before the un-graceful exit below
+                self._emit(s, step=step, exit_code=code)
                 print(f"fault-injection: rank {rank} dying before step "
                       f"{step} ({s.kind}, exit {code})",
                       file=sys.stderr, flush=True)

@@ -219,7 +219,7 @@ class Supervisor:
                  deadline_s: float = 900.0, poll_interval_s: float = 0.2,
                  sleep: Callable[[float], None] = time.sleep,
                  clock: Callable[[], float] = time.monotonic,
-                 log: Callable[[str], None] | None = None):
+                 log: Callable[[str], None] | None = None, bus=None):
         self.launcher = launcher
         self.policy = policy
         self.monitor_factory = monitor_factory
@@ -230,6 +230,15 @@ class Supervisor:
         self._sleep = sleep
         self._clock = clock
         self._log = log or (lambda msg: print(msg, flush=True))
+        # obs.EventBus (or None): every detect->decide->relaunch step
+        # lands on the merged run timeline, so the post-mortem shows the
+        # same story SupervisorResult summarizes — launch attempts, which
+        # rank died how, restart-vs-shrink decisions, the terminal reason
+        self._bus = bus
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self._bus is not None:
+            self._bus.emit(kind, **fields)
 
     def run(self) -> SupervisorResult:
         deadline = self._clock() + self.deadline_s
@@ -246,11 +255,22 @@ class Supervisor:
                 storm_charges=self.policy.storm_charges)
 
         while True:
+            self._emit("gang_launch", attempt=plan.attempt,
+                       world_size=plan.world_size,
+                       resume_step=plan.resume_step,
+                       restore_ranks=(list(plan.restore_ranks)
+                                      if plan.restore_ranks is not None
+                                      else None))
             gang = self.launcher.launch(plan)
             monitor = (self.monitor_factory(world)
                        if self.monitor_factory else None)
             failure = self._watch(gang, monitor, deadline)
             if failure is None:
+                self._emit("supervisor_done", outcome="completed",
+                           reason=None, restarts=plan.attempt,
+                           world_size=world,
+                           budget_spent=self.policy.spent,
+                           storm_charges=self.policy.storm_charges)
                 return result("completed", None, gang.outputs(),
                               events[-1].detected_by if events else None)
             gang.kill()
@@ -259,6 +279,10 @@ class Supervisor:
                 attempt=plan.attempt, world_size=world, rank=failure.rank,
                 detected_by=failure.detected_by,
                 permanent=failure.permanent, charge=charge))
+            self._emit("rank_failure", attempt=plan.attempt,
+                       world_size=world, failed_rank=failure.rank,
+                       detected_by=failure.detected_by,
+                       permanent=failure.permanent, charge=charge)
             if self.policy.exhausted():
                 storm = (f", {self.policy.storm_charges} storm-doubled"
                          if self.policy.storm_charges else "")
@@ -268,6 +292,11 @@ class Supervisor:
                     f"max_restarts={self.policy.max_restarts}{storm}; "
                     f"last: rank {failure.rank} ({failure.detected_by})")
                 self._log(f"supervisor: giving up — {reason}")
+                self._emit("supervisor_done", outcome="gave_up",
+                           reason=reason, restarts=plan.attempt,
+                           world_size=world,
+                           budget_spent=self.policy.spent,
+                           storm_charges=self.policy.storm_charges)
                 return result("gave_up", reason, gang.outputs(),
                               failure.detected_by)
             if failure.permanent:
@@ -279,6 +308,11 @@ class Supervisor:
                         f"surviving world {len(survivors)} is below "
                         f"min_world={self.min_world}")
                     self._log(f"supervisor: giving up — {reason}")
+                    self._emit("supervisor_done", outcome="gave_up",
+                               reason=reason, restarts=plan.attempt,
+                               world_size=world,
+                               budget_spent=self.policy.spent,
+                               storm_charges=self.policy.storm_charges)
                     return result("gave_up", reason, gang.outputs(),
                                   failure.detected_by)
                 done = self.launcher.completed_steps(survivors)
@@ -287,6 +321,12 @@ class Supervisor:
                     restore = tuple(survivors)
                 else:
                     resume, restore = None, None   # fresh, but smaller
+                self._emit("gang_shrink", from_world=world,
+                           to_world=len(survivors),
+                           lost_rank=failure.rank, resume_step=resume,
+                           restore_ranks=(list(restore)
+                                          if restore is not None
+                                          else None))
                 world = len(survivors)
                 self._log(
                     f"supervisor: rank {failure.rank} permanently lost "
@@ -298,6 +338,8 @@ class Supervisor:
                 done = self.launcher.completed_steps(list(range(world)))
                 if len(done) == world:
                     resume, restore = min(done.values()), None
+                    self._emit("gang_restart", world_size=world,
+                               resume_step=resume)
                     self._log(
                         f"supervisor: rank {failure.rank} dead "
                         f"({failure.detected_by}); restarting gang from "
@@ -308,6 +350,8 @@ class Supervisor:
                     # point ranks at files that do not exist and crash
                     # the restarted gang
                     resume, restore = None, None
+                    self._emit("gang_restart", world_size=world,
+                               resume_step=None)
                     self._log(
                         f"supervisor: rank {failure.rank} dead "
                         f"({failure.detected_by}) before all ranks "
@@ -387,7 +431,8 @@ class SubprocessGangLauncher(Launcher):
 
     def __init__(self, *, n_processes: int, devices_per_process: int,
                  steps: int, env: dict, base_dir: str,
-                 faults: Sequence[str] = (), repo_root: str | None = None):
+                 faults: Sequence[str] = (), repo_root: str | None = None,
+                 obs_dir: str | None = None):
         self.world_size = n_processes
         self._initial_world = n_processes
         self.devices_per_process = devices_per_process
@@ -396,6 +441,10 @@ class SubprocessGangLauncher(Launcher):
         self.base_dir = base_dir
         self.faults = tuple(faults)
         self.repo_root = repo_root or os.getcwd()
+        # per-rank event streams land here (workers get --obs-dir); a
+        # relaunched rank APPENDS to its stream, so one file tells the
+        # rank's whole story across attempts
+        self.obs_dir = obs_dir
         self.hb_dir = os.path.join(base_dir, "hb")
         self.ckpt_dir = os.path.join(base_dir, "ckpt")
         os.makedirs(self.hb_dir, exist_ok=True)
@@ -422,6 +471,8 @@ class SubprocessGangLauncher(Launcher):
                    "--steps", str(self.steps),
                    "--heartbeat-dir", self.hb_dir,
                    "--ckpt-dir", self.ckpt_dir, "--no-pbt-check"]
+            if self.obs_dir is not None:
+                cmd += ["--obs-dir", self.obs_dir]
             if plan.resume_step is not None:
                 cmd += ["--resume-step", str(plan.resume_step)]
                 if plan.restore_ranks is not None:

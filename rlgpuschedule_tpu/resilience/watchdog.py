@@ -53,7 +53,8 @@ class DivergenceWatchdog:
     """
 
     def __init__(self, max_rollbacks: int = 3, lr_decay: float = 0.5,
-                 blowup_factor: float = 1e4, ema_decay: float = 0.9):
+                 blowup_factor: float = 1e4, ema_decay: float = 0.9,
+                 bus=None):
         if max_rollbacks < 0:
             raise ValueError(f"max_rollbacks must be >= 0, "
                              f"got {max_rollbacks}")
@@ -64,6 +65,7 @@ class DivergenceWatchdog:
         self.n_rollbacks = 0
         self.events: list[RollbackEvent] = []
         self._loss_ema: float | None = None
+        self._bus = bus   # obs.EventBus (or None): rollback timeline
 
     def check(self, metrics: dict[str, float]) -> str | None:
         """Reason string if this iteration's metrics look divergent, else
@@ -125,6 +127,8 @@ class DivergenceWatchdog:
             resume_iteration=resume, n_rollback=self.n_rollbacks,
             lr_scale=scale, reason=reason)
         self.events.append(event)
+        if self._bus is not None:
+            self._bus.emit("rollback", **event.as_dict())
         print(f"watchdog: {reason} at iteration {iteration} -> rolled "
               f"back to checkpoint step {event.restored_step} (resume "
               f"iteration {resume}, lr x{scale:g}, rollback "

@@ -150,6 +150,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "interleaves host-side between steps)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the run")
+    # observability (obs/): structured event bus + metrics snapshot +
+    # production alarms — the run's post-mortem surface
+    p.add_argument("--obs-dir", default=None,
+                   help="unified telemetry: append structured events "
+                        "(JSONL event bus, schema-versioned, rank/pid/"
+                        "monotonic-stamped) and a Prometheus-text "
+                        "metrics snapshot (metrics.prom) under this "
+                        "directory; post-mortem via "
+                        "python -m rlgpuschedule_tpu.obs.report <dir>")
+    p.add_argument("--alarms", action="store_true",
+                   help="production alarms (requires --obs-dir): a "
+                        "post-warmup dispatch that traces/compiles emits "
+                        "a recompile event (the silent throughput killer "
+                        "the test-only CompileCounter gate catches only "
+                        "in CI), and an implicit host<->device transfer "
+                        "in the dispatch emits a transfer event and "
+                        "fails fast")
+    p.add_argument("--alarm-slow-iter", type=float, default=None,
+                   metavar="SECONDS",
+                   help="with --alarms: an iteration slower than this "
+                        "emits a slow_iteration event and auto-captures "
+                        "a one-shot jax.profiler trace of the NEXT "
+                        "iteration under <obs-dir>/profile")
     p.add_argument("--debug-nans", action="store_true",
                    help="run under jax_debug_nans (sanitizer hook — the "
                         "functional design has no data races to detect, so "
@@ -370,6 +393,15 @@ def main(argv: list[str] | None = None) -> dict:
         if not args.ckpt_dir:
             sys.exit("--max-rollbacks requires --ckpt-dir (rollback "
                      "restores the last good checkpoint)")
+    if args.alarms and not args.obs_dir:
+        sys.exit("--alarms requires --obs-dir (alarm events need an "
+                 "event stream to land in)")
+    if args.alarm_slow_iter is not None:
+        if not args.alarms:
+            sys.exit("--alarm-slow-iter is an alarm trigger; pass "
+                     "--alarms (and --obs-dir) with it")
+        if args.alarm_slow_iter <= 0:
+            sys.exit("--alarm-slow-iter must be positive")
     cfg = apply_overrides(CONFIGS[args.config], args)
     if args.source_jobs is not None:
         if args.source_jobs <= 0:
@@ -385,16 +417,32 @@ def main(argv: list[str] | None = None) -> dict:
 
     enable_compile_cache()
 
-    ckpt = None
-    if args.ckpt_dir:
-        from .checkpoint import Checkpointer
-        import os
-        ckpt = Checkpointer(os.path.abspath(args.ckpt_dir),
-                            max_to_keep=args.ckpt_keep or 3)
-
     with contextlib.ExitStack() as stack:
+        # telemetry first: its event bus threads through the checkpoint
+        # store, watchdog and injector below (and the ExitStack closes
+        # it LAST, so their teardown events still have a live bus)
+        telemetry = None
+        bus = None
+        if args.obs_dir:
+            import os
+
+            from .obs import RunTelemetry
+            telemetry = stack.enter_context(RunTelemetry(
+                os.path.abspath(args.obs_dir), rank=0,
+                alarms=args.alarms, slow_iter_s=args.alarm_slow_iter))
+            bus = telemetry.bus
+        ckpt = None
+        if args.ckpt_dir:
+            from .checkpoint import Checkpointer
+            import os
+            ckpt = Checkpointer(os.path.abspath(args.ckpt_dir),
+                                max_to_keep=args.ckpt_keep or 3, bus=bus)
+        # --resume APPENDS to the existing metrics CSV (header re-read +
+        # schema-validated) instead of truncating the history a relaunch
+        # is trying to continue
         csv_logger = stack.enter_context(
-            MetricsLogger(args.log_csv, echo=args.log_every > 0))
+            MetricsLogger(args.log_csv, echo=args.log_every > 0,
+                          append=args.resume))
         logger = csv_logger
         if args.tb_dir:
             from .utils import TensorBoardWriter
@@ -439,7 +487,7 @@ def main(argv: list[str] | None = None) -> dict:
                 import os
                 best_ckpt = stack.enter_context(Checkpointer(
                     os.path.join(os.path.abspath(args.ckpt_dir), "best"),
-                    max_to_keep=1))
+                    max_to_keep=1, bus=bus))
                 best = {"jct": float("inf")}
                 if best_ckpt.latest_step() is not None:
                     # a resumed run must not rotate out a prior run's
@@ -471,7 +519,8 @@ def main(argv: list[str] | None = None) -> dict:
                 eval_every=args.eval_every, eval_fn=probe,
                 eval_logger=stack.enter_context(
                     MetricsLogger(args.log_csv + ".eval.csv"
-                                  if args.log_csv else None, echo=True)))
+                                  if args.log_csv else None, echo=True,
+                                  append=args.resume)))
 
         run_kw = {}
         if args.fused_chunk > 1:
@@ -483,10 +532,12 @@ def main(argv: list[str] | None = None) -> dict:
         if args.max_rollbacks is not None:
             from .resilience import DivergenceWatchdog
             run_kw["watchdog"] = DivergenceWatchdog(
-                max_rollbacks=args.max_rollbacks)
+                max_rollbacks=args.max_rollbacks, bus=bus)
         if faults:
             from .resilience import FaultInjector
-            run_kw["injector"] = FaultInjector(faults)
+            run_kw["injector"] = FaultInjector(faults, bus=bus)
+        if telemetry is not None:
+            run_kw["telemetry"] = telemetry
         from .resilience import DivergenceError
         try:
             out = exp.run(log_every=args.log_every, logger=logger,

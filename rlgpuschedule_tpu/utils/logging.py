@@ -28,31 +28,63 @@ class MetricsLogger:
     run); any later row whose keys differ from the first row's raises, so
     schema drift is caught at the call site rather than producing ragged
     CSVs.
+
+    ``append=True`` is the supervisor-relaunch / ``--resume`` mode: an
+    existing CSV's header is re-read and becomes the pinned schema, new
+    rows are APPENDED after the history instead of truncating it (mode
+    ``"w"`` silently wiped every pre-restart row — the metrics history a
+    relaunch exists to continue), and a resumed run whose row keys drift
+    from the original header raises the same schema error as in-run
+    drift. An ``append=True`` open of a missing/empty file degrades to
+    the fresh-file path.
+
+    ``wall_s`` is a DURATION (seconds since this logger was built) and
+    is therefore measured on ``time.monotonic()`` — a wall-clock step
+    (NTP) mid-run would otherwise bend every downstream steps/s
+    computation; event timestamps (wall time proper) belong to the obs
+    event bus, not this column.
     """
 
     def __init__(self, csv_path: str | None = None, echo: bool = False,
-                 stream: IO[str] | None = None):
+                 stream: IO[str] | None = None, append: bool = False):
         self._csv_path = csv_path
         self._echo = echo
+        self._append = append
         self._stream = stream or sys.stderr
         self._writer: csv.DictWriter | None = None
         self._file: IO[str] | None = None
         self._fields: list[str] | None = None
-        self._t0 = time.time()
+        self._t0 = time.monotonic()
+
+    def _open(self, first_row: Mapping[str, Any]) -> None:
+        os.makedirs(os.path.dirname(self._csv_path) or ".", exist_ok=True)
+        header: list[str] | None = None
+        if self._append and os.path.exists(self._csv_path):
+            with open(self._csv_path, newline="") as f:
+                header = next(csv.reader(f), None)
+        if header:
+            if set(first_row) != set(header):
+                raise ValueError(
+                    f"metrics schema drift across resume: existing CSV "
+                    f"header has {sorted(header)}, this run logs "
+                    f"{sorted(first_row)}")
+            self._file = open(self._csv_path, "a", newline="")
+            self._fields = list(header)   # keep the original column order
+            self._writer = csv.DictWriter(self._file, self._fields)
+        else:
+            self._file = open(self._csv_path, "w", newline="")
+            self._fields = list(first_row)
+            self._writer = csv.DictWriter(self._file, self._fields)
+            self._writer.writeheader()
 
     def __call__(self, iteration: int, metrics: Mapping[str, Any]) -> None:
         row = {"iteration": iteration,
-               "wall_s": round(time.time() - self._t0, 3)}
+               "wall_s": round(time.monotonic() - self._t0, 3)}
         for k, v in metrics.items():
             row[k] = float(v) if hasattr(v, "__float__") else v
         if self._csv_path is not None:
             if self._writer is None:
-                os.makedirs(os.path.dirname(self._csv_path) or ".",
-                            exist_ok=True)
-                self._file = open(self._csv_path, "w", newline="")
-                self._fields = list(row)
-                self._writer = csv.DictWriter(self._file, self._fields)
-                self._writer.writeheader()
+                self._open(row)
             elif set(row) != set(self._fields):
                 raise ValueError(
                     f"metrics schema drift: first row had "
@@ -198,10 +230,16 @@ class TensorBoardWriter:
 
 class ThroughputMeter:
     """env-steps/sec tracker for the north-star throughput metric
-    (SURVEY.md §6 metric #1). Call ``tick(n_steps)`` once per iteration."""
+    (SURVEY.md §6 metric #1). Call ``tick(n_steps)`` once per iteration.
 
-    def __init__(self):
-        self._t0 = time.time()
+    Durations come from ``time.monotonic()`` — the same wall-clock-jump
+    bug class the heartbeat stamps fixed (PR 4): an NTP step mid-run
+    would otherwise dent (or inflate) the headline steps/s. ``clock`` is
+    injectable for deterministic tests."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._t0 = clock()
         self._steps = 0
 
     def tick(self, n_steps: int) -> None:
@@ -209,5 +247,5 @@ class ThroughputMeter:
 
     @property
     def steps_per_sec(self) -> float:
-        dt = time.time() - self._t0
+        dt = self._clock() - self._t0
         return self._steps / dt if dt > 0 else 0.0

@@ -59,19 +59,28 @@ def test_dryrun_multihost_2proc():
 
 
 @pytest.mark.multihost_spawn
-def test_dryrun_multihost_supervised_recovers_killed_rank():
+def test_dryrun_multihost_supervised_recovers_killed_rank(tmp_path):
     """Acceptance (a), ISSUE 4: rank 1 is fault-injected to die right
     before step 2 (kill-rank — a RESTARTABLE death); the supervisor
     detects it (fast path: non-zero exit; general path: stale heartbeat),
     restarts the gang AT THE SAME world size from the per-rank step-2
     checkpoints, and the restarted ranks finish with IDENTICAL
     replicated-params fingerprints — i.e. restart-from-checkpoint
-    preserved the collective's state, losing at most one step of work."""
+    preserved the collective's state, losing at most one step of work.
+
+    ISSUE 5 acceptance rides along: with ``obs_dir`` wired through, the
+    merged per-rank event timeline must tell the SAME restart story as
+    the returned SupervisorResult — supervisor-side detect/decide events
+    agreeing with the worker-side fault/resume events, in causal order
+    under the shared monotonic clock."""
     import __graft_entry__ as ge
 
+    from rlgpuschedule_tpu.obs import merge_dir
+
+    obs = str(tmp_path / "obs")
     out = ge.dryrun_multihost_supervised(
         n_processes=2, devices_per_process=2, steps=4, kill_step=2,
-        kill_rank=1)
+        kill_rank=1, obs_dir=obs)
     assert out["restarts"] == 1
     # kill-before-the-collective: the dying rank checkpointed >= step 2,
     # a peer torn down mid-step may be one behind — at most one step lost
@@ -79,9 +88,36 @@ def test_dryrun_multihost_supervised_recovers_killed_rank():
     assert out["detected_by"].startswith(("exit=", "heartbeat"))
     assert out["world_size"] == 2 and not out["shrunk"]
 
+    events = merge_dir(obs)
+    kinds = [e["kind"] for e in events]
+    # one launch per attempt: initial + out["restarts"]
+    assert kinds.count("gang_launch") == 1 + out["restarts"]
+    fails = [e for e in events if e["kind"] == "rank_failure"]
+    assert [(e["failed_rank"], e["permanent"]) for e in fails] == \
+        [(1, False)]
+    assert fails[0]["detected_by"] == out["detected_by"]
+    restart = next(e for e in events if e["kind"] == "gang_restart")
+    assert restart["world_size"] == 2
+    assert restart["resume_step"] == out["resume_step"]
+    assert "gang_shrink" not in kinds
+    done = next(e for e in events if e["kind"] == "supervisor_done")
+    assert done["outcome"] == "completed"
+    assert done["budget_spent"] == out["budget_spent"]
+    # worker-side story agrees: rank 1's fault fired, and after the
+    # relaunch both ranks resumed from the supervisor's chosen step
+    fault = next(e for e in events if e["kind"] == "fault")
+    assert (fault["rank"], fault["fault"]) == (1, "kill-rank")
+    resumed = [e for e in events if e["kind"] == "worker_resumed"]
+    assert sorted(e["rank"] for e in resumed) == [0, 1]
+    assert all(e["step"] == out["resume_step"] for e in resumed)
+    # causal order on the merged timeline: fault -> detection ->
+    # relaunch decision -> workers resume
+    assert kinds.index("fault") < kinds.index("rank_failure") \
+        < kinds.index("gang_restart") < kinds.index("worker_resumed")
+
 
 @pytest.mark.multihost_spawn
-def test_dryrun_multihost_elastic_shrinks_to_surviving_world():
+def test_dryrun_multihost_elastic_shrinks_to_surviving_world(tmp_path):
     """Acceptance (b), ISSUE 4 — shrink-to-fit: rank 1 of 3 is
     PERMANENTLY lost (lose-rank -> exit 23) before step 2. The
     supervisor must relaunch at world size 2, mapping the new ranks onto
@@ -90,15 +126,41 @@ def test_dryrun_multihost_elastic_shrinks_to_surviving_world():
     2-rank gang must finish with MATCHING cross-rank fingerprints at the
     new size — the fingerprint contract holds at any world size.
     1 device per rank: the surface under test is the world-size change,
-    and the smaller per-rank mesh keeps 3+2 spawned compiles cheap."""
+    and the smaller per-rank mesh keeps 3+2 spawned compiles cheap.
+
+    ISSUE 5: the merged timeline's ``gang_shrink`` event must match the
+    SupervisorResult's shrink (3 -> 2, the lost rank named, the restore
+    rank map pointing every new rank at a surviving old rank)."""
     import __graft_entry__ as ge
 
+    from rlgpuschedule_tpu.obs import merge_dir
     from rlgpuschedule_tpu.resilience import LOSE_RANK_EXIT
 
+    obs = str(tmp_path / "obs")
     out = ge.dryrun_multihost_elastic(
         n_processes=3, devices_per_process=1, steps=4, lose_step=2,
-        lose_rank=1)
+        lose_rank=1, obs_dir=obs)
     assert out["shrunk"] and out["world_size"] == 2
     assert out["restarts"] == 1
     assert out["resume_step"] >= 1
     assert out["detected_by"] == f"exit={LOSE_RANK_EXIT}"
+
+    events = merge_dir(obs)
+    kinds = [e["kind"] for e in events]
+    shrink = next(e for e in events if e["kind"] == "gang_shrink")
+    assert (shrink["from_world"], shrink["to_world"]) == (3, 2)
+    assert shrink["lost_rank"] == 1
+    assert shrink["resume_step"] == out["resume_step"]
+    assert shrink["restore_ranks"] == [0, 2]   # survivors of losing 1
+    fails = [e for e in events if e["kind"] == "rank_failure"]
+    assert [(e["failed_rank"], e["permanent"]) for e in fails] == \
+        [(1, True)]
+    assert "gang_restart" not in kinds   # this drill shrinks, not respawns
+    done = next(e for e in events if e["kind"] == "supervisor_done")
+    assert (done["outcome"], done["world_size"]) == ("completed", 2)
+    # the shrunk gang's two ranks each restored a SURVIVING old rank's
+    # checkpoint at the supervisor's resume step
+    resumed = [e for e in events if e["kind"] == "worker_resumed"]
+    assert sorted((e["rank"], e["from_rank"]) for e in resumed) == \
+        [(0, 0), (1, 2)]
+    assert all(e["step"] == out["resume_step"] for e in resumed)

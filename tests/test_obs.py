@@ -1,0 +1,446 @@
+"""Unified telemetry layer tests (ISSUE 5): event-bus schema round-trip,
+rank-merge ordering under interleaved monotonic clocks, torn-last-line
+tolerance, the counters/gauges registry's Prometheus snapshot, the
+production alarms (recompile / transfer — the alarm-fires-on-forced-
+recompile gate mirrors tests/test_sentinels.py's geometry-change
+control), the span-traced run loop (including the zero-added-host-syncs
+contract: ONE device_get per logged iteration, telemetry attached or
+not), the report CLI, and the MetricsLogger append/resume satellite.
+"""
+import csv
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rlgpuschedule_tpu.algos import PPOConfig
+from rlgpuschedule_tpu.configs import CONFIGS
+from rlgpuschedule_tpu.obs import (AlarmError, Alarms, EventBus, Registry,
+                                   RunTelemetry, SCHEMA_VERSION, merge_dir,
+                                   merge_events, read_events)
+from rlgpuschedule_tpu.obs import report as report_cli
+from rlgpuschedule_tpu.utils import MetricsLogger, ThroughputMeter
+
+# same shapes as test_resilience/test_checkpoint so the persistent XLA
+# cache already holds every program this file compiles
+SMALL = dataclasses.replace(
+    CONFIGS["ppo-mlp-synth64"], n_envs=2, window_jobs=16, horizon=64,
+    ppo=PPOConfig(n_steps=8, n_epochs=1, n_minibatches=2))
+
+
+class TestEventBus:
+    def test_schema_roundtrip(self, tmp_path):
+        with EventBus(str(tmp_path), rank=3) as bus:
+            bus.emit("run_start", config="x", iterations=7)
+            bus.emit("iteration", iteration=0, phases={"step": 0.5})
+        events = read_events(bus.path)
+        assert [e["kind"] for e in events] == ["run_start", "iteration"]
+        first = events[0]
+        assert first["v"] == SCHEMA_VERSION
+        assert first["rank"] == 3 and first["pid"] == os.getpid()
+        assert first["seq"] == 0 and events[1]["seq"] == 1
+        assert isinstance(first["mono"], float)
+        assert isinstance(first["wall"], float)
+        assert first["config"] == "x" and first["iterations"] == 7
+        assert events[1]["phases"] == {"step": 0.5}
+
+    def test_reserved_field_collision_raises(self, tmp_path):
+        with EventBus(str(tmp_path)) as bus:
+            with pytest.raises(ValueError, match="stamp"):
+                bus.emit("x", rank=9)
+
+    def test_closed_bus_refuses_emit(self, tmp_path):
+        bus = EventBus(str(tmp_path))
+        bus.close()
+        with pytest.raises(ValueError, match="closed"):
+            bus.emit("x")
+
+    def test_torn_last_line_tolerated(self, tmp_path):
+        with EventBus(str(tmp_path), rank=0) as bus:
+            bus.emit("a")
+            bus.emit("b")
+        # a writer killed mid-write leaves a truncated last line — the
+        # one torn state append+flush-per-event can produce
+        with open(bus.path, "a") as f:
+            f.write('{"v": 1, "kind": "tor')
+        events = read_events(bus.path)
+        assert [e["kind"] for e in events] == ["a", "b"]
+
+    def test_merge_orders_interleaved_monotonic_clocks(self, tmp_path):
+        # two ranks whose emissions interleave in time but are written
+        # to separate streams; the merge must re-interleave them by the
+        # shared monotonic clock, not file order
+        clock_a = iter([1.0, 4.0, 5.0])
+        clock_b = iter([2.0, 3.0, 6.0])
+        with EventBus(str(tmp_path), rank=0,
+                      clock=lambda: next(clock_a)) as a, \
+                EventBus(str(tmp_path), rank=1,
+                         clock=lambda: next(clock_b)) as b:
+            a.emit("a0")
+            b.emit("b0")
+            b.emit("b1")
+            a.emit("a1")
+            a.emit("a2")
+            b.emit("b2")
+        merged = merge_dir(str(tmp_path))
+        assert [e["kind"] for e in merged] == \
+            ["a0", "b0", "b1", "a1", "a2", "b2"]
+
+    def test_merge_tie_breaks_deterministically(self):
+        tie = [{"mono": 1.0, "rank": 1, "seq": 0, "kind": "r1"},
+               {"mono": 1.0, "rank": 0, "seq": 1, "kind": "r0b"},
+               {"mono": 1.0, "rank": 0, "seq": 0, "kind": "r0a"}]
+        assert [e["kind"] for e in merge_events(tie)] == \
+            ["r0a", "r0b", "r1"]
+
+    def test_merge_dir_without_streams_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="no event streams"):
+            merge_dir(str(tmp_path))
+
+    def test_relaunched_rank_appends_to_its_stream(self, tmp_path):
+        # a supervisor relaunch reopens the same rank id: one stream
+        # tells the rank's whole story across attempts
+        with EventBus(str(tmp_path), rank=0) as bus:
+            bus.emit("worker_start")
+        with EventBus(str(tmp_path), rank=0) as bus:
+            bus.emit("worker_start")
+        events = read_events(bus.path)
+        assert [e["kind"] for e in events] == ["worker_start"] * 2
+
+
+class TestRegistry:
+    def test_counter_and_gauge_render_prometheus_text(self):
+        r = Registry()
+        c = r.counter("rlsched_iterations_total", "iterations run")
+        c.inc()
+        c.inc(2)
+        r.gauge("rlsched_env_steps_per_sec", "throughput").set(12.5)
+        text = r.render()
+        assert "# HELP rlsched_iterations_total iterations run" in text
+        assert "# TYPE rlsched_iterations_total counter" in text
+        assert "rlsched_iterations_total 3" in text
+        assert "# TYPE rlsched_env_steps_per_sec gauge" in text
+        assert "rlsched_env_steps_per_sec 12.5" in text
+
+    def test_counter_refuses_negative_increment(self):
+        with pytest.raises(ValueError, match="negative"):
+            Registry().counter("c").inc(-1)
+
+    def test_reregistration_returns_same_object_kind_mismatch_raises(self):
+        r = Registry()
+        assert r.counter("c") is r.counter("c")
+        with pytest.raises(ValueError, match="already registered"):
+            r.gauge("c")
+
+    def test_bad_metric_name_raises(self):
+        with pytest.raises(ValueError, match="bad metric name"):
+            Registry().counter("steps/s")
+
+    def test_write_snapshot_atomic(self, tmp_path):
+        r = Registry()
+        r.counter("c").inc(5)
+        path = str(tmp_path / "metrics.prom")
+        r.write(path)
+        assert open(path).read() == r.render()
+        assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
+class TestMetricsLoggerAppend:
+    """Satellite: a supervisor relaunch / --resume must APPEND to the
+    metrics CSV instead of truncating the history (mode "w" wiped it)."""
+
+    def test_append_resumes_without_truncation(self, tmp_path):
+        path = str(tmp_path / "m.csv")
+        with MetricsLogger(path) as log:
+            log(0, {"loss": 1.5})
+            log(1, {"loss": 1.0})
+        with MetricsLogger(path, append=True) as log:
+            log(2, {"loss": 0.5})
+        rows = list(csv.DictReader(open(path)))
+        assert [r["iteration"] for r in rows] == ["0", "1", "2"]
+        assert float(rows[2]["loss"]) == 0.5
+        # exactly one header line in the file
+        with open(path) as f:
+            assert sum(1 for line in f if line.startswith("iteration")) == 1
+
+    def test_append_validates_schema_against_existing_header(self, tmp_path):
+        path = str(tmp_path / "m.csv")
+        with MetricsLogger(path) as log:
+            log(0, {"loss": 1.5})
+        with MetricsLogger(path, append=True) as log:
+            with pytest.raises(ValueError, match="schema drift"):
+                log(1, {"reward": -1.0})
+
+    def test_append_on_fresh_file_degrades_to_write(self, tmp_path):
+        path = str(tmp_path / "m.csv")
+        with MetricsLogger(path, append=True) as log:
+            log(0, {"loss": 1.5})
+        rows = list(csv.DictReader(open(path)))
+        assert len(rows) == 1
+
+    def test_throughput_meter_uses_injected_monotonic_clock(self):
+        ticks = iter([0.0, 10.0])
+        m = ThroughputMeter(clock=lambda: next(ticks))
+        m.tick(50)
+        assert m.steps_per_sec == pytest.approx(5.0)
+
+
+class TestAlarms:
+    """The production-alarm gate, mirroring test_sentinels' geometry-
+    change control: a forced recompile in a post-warmup dispatch MUST
+    emit a ``recompile`` event; geometry-stable dispatches must not."""
+
+    @pytest.mark.sanitize
+    def test_recompile_alarm_fires_on_forced_recompile(self, tmp_path):
+        bus = EventBus(str(tmp_path), rank=0)
+        f = jax.jit(lambda x: x * 3 + 1)
+        x_warm = jnp.ones((4, 5))
+        x_fresh = jnp.ones((6, 7))   # built OUTSIDE the guarded dispatch
+        with Alarms(bus, warmup_iters=1) as al:
+            with al.dispatch(0):     # warmup: the one allowed compile
+                f(x_warm).block_until_ready()
+            with al.dispatch(1):     # steady state: cached, clean
+                f(x_warm).block_until_ready()
+            with al.dispatch(2):     # forced recompile: shape change
+                f(x_fresh).block_until_ready()
+            with al.dispatch(3):     # control: BACK to a cached shape
+                f(x_warm).block_until_ready()
+        bus.close()
+        events = read_events(bus.path)
+        kinds = [(e["kind"], e["iteration"]) for e in events]
+        assert ("compile", 0) in kinds       # warmup recorded, not alarmed
+        assert ("recompile", 2) in kinds     # the alarm
+        alarmed = [i for k, i in kinds if k == "recompile"]
+        assert alarmed == [2]                # 1 and 3 stayed clean
+        assert al.registry.counter(
+            "rlsched_recompile_alarms_total").value == 1
+
+    @pytest.mark.sanitize
+    def test_transfer_alarm_emits_and_fails_fast(self, tmp_path):
+        bus = EventBus(str(tmp_path), rank=0)
+        dev = jnp.arange(8.0)
+        host = np.ones(8, np.float32)   # implicit host->device operand
+        with Alarms(bus, warmup_iters=0) as al:
+            with pytest.raises(AlarmError, match="transfer alarm"):
+                with al.dispatch(0):
+                    (dev + host).block_until_ready()
+        bus.close()
+        events = read_events(bus.path)
+        assert [e["kind"] for e in events] == ["transfer"]
+        assert al.registry.counter(
+            "rlsched_transfer_alarms_total").value == 1
+
+    def test_expected_recompile_amnesty(self, tmp_path):
+        bus = EventBus(str(tmp_path), rank=0)
+        f = jax.jit(lambda x: x - 2)
+        a, b = jnp.ones((3, 11)), jnp.ones((5, 13))
+        with Alarms(bus, warmup_iters=1) as al:
+            with al.dispatch(0):
+                f(a).block_until_ready()
+            al.expect_recompile("rollback lr rescale")
+            with al.dispatch(1):            # re-trace, but blessed
+                f(b).block_until_ready()
+        bus.close()
+        events = read_events(bus.path)
+        assert [e["kind"] for e in events] == ["compile", "compile"]
+        assert events[1]["expected"] == "rollback lr rescale"
+
+    def test_slow_iteration_alarm(self, tmp_path):
+        bus = EventBus(str(tmp_path), rank=0)
+        with Alarms(bus, warmup_iters=0, slow_iter_s=0.5,
+                    profile_dir=None) as al:
+            al.observe_wall(4, 0.1)     # fast: no alarm
+            al.observe_wall(5, 2.0)     # slow: alarm
+        bus.close()
+        events = read_events(bus.path)
+        assert [(e["kind"], e["iteration"]) for e in events] == \
+            [("slow_iteration", 5)]
+        assert events[0]["threshold_s"] == 0.5
+
+
+class TestRunTelemetry:
+    def test_experiment_run_emits_spans_and_stays_alarm_clean(
+            self, tmp_path):
+        """3 geometry-stable iterations under full telemetry + alarms:
+        run_start / per-iteration spans with the phase breakdown /
+        run_end on the stream, the Prometheus snapshot on disk, and ZERO
+        recompile/transfer alarm events after the warmup iteration (the
+        acceptance criterion)."""
+        from rlgpuschedule_tpu.experiment import Experiment
+        exp = Experiment.build(SMALL)
+        with RunTelemetry(str(tmp_path), rank=0, alarms=True) as tel:
+            out = exp.run(iterations=3, log_every=1, telemetry=tel)
+        assert out["iterations"] == 3
+        events = read_events(tel.bus.path)
+        kinds = [e["kind"] for e in events]
+        assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+        iters = [e for e in events if e["kind"] == "iteration"]
+        assert [e["iteration"] for e in iters] == [0, 1, 2]
+        assert all("step" in e["phases"] for e in iters)
+        assert all("sync" in e["phases"] for e in iters)
+        assert all(np.isfinite(e["metrics"]["total_loss"])
+                   for e in iters)
+        assert "recompile" not in kinds and "transfer" not in kinds
+        prom = open(os.path.join(str(tmp_path), "metrics.prom")).read()
+        assert "rlsched_iterations_total 3" in prom
+        assert "rlsched_env_steps_total 48" in prom   # 3 * 8 * 2
+
+    def test_host_sync_count_unchanged_by_telemetry(self, tmp_path,
+                                                    monkeypatch):
+        """The zero-added-host-syncs contract: an instrumented run calls
+        jax.device_get exactly once per logged iteration — the same
+        single batched sync the bare loop pays (jsan host-sync review,
+        PR 3)."""
+        from rlgpuschedule_tpu.experiment import Experiment
+        exp = Experiment.build(SMALL)
+        calls = {"n": 0}
+        real = jax.device_get
+
+        def counting(x):
+            calls["n"] += 1
+            return real(x)
+
+        with RunTelemetry(str(tmp_path), rank=0, alarms=True) as tel:
+            monkeypatch.setattr(jax, "device_get", counting)
+            exp.run(iterations=3, log_every=1, telemetry=tel)
+            monkeypatch.setattr(jax, "device_get", real)
+        assert calls["n"] == 3   # one per logged iteration, none extra
+
+    def test_rollback_story_lands_on_one_timeline(self, tmp_path):
+        """fault -> ckpt_restore -> rollback -> amnestied compile on the
+        merged stream, and the retry's legitimate re-trace does NOT fire
+        the recompile alarm."""
+        from rlgpuschedule_tpu.checkpoint import Checkpointer
+        from rlgpuschedule_tpu.experiment import Experiment
+        from rlgpuschedule_tpu.resilience import (DivergenceWatchdog,
+                                                  FaultInjector,
+                                                  parse_fault)
+        obs = str(tmp_path / "obs")
+        exp = Experiment.build(SMALL)
+        with RunTelemetry(obs, rank=0, alarms=True) as tel:
+            with Checkpointer(str(tmp_path / "ck"), bus=tel.bus) as ckpt:
+                out = exp.run(
+                    iterations=3, log_every=1, ckpt=ckpt, ckpt_every=1,
+                    watchdog=DivergenceWatchdog(max_rollbacks=1,
+                                                bus=tel.bus),
+                    injector=FaultInjector([parse_fault("nan-grad@1")],
+                                           bus=tel.bus),
+                    telemetry=tel)
+        assert out["rollbacks"] == 1
+        events = merge_dir(obs)
+        kinds = [e["kind"] for e in events]
+        assert "fault" in kinds and "rollback" in kinds
+        assert "ckpt_save" in kinds and "ckpt_restore" in kinds
+        assert kinds.index("fault") < kinds.index("rollback")
+        assert "recompile" not in kinds   # retry re-trace was amnestied
+        rb = next(e for e in events if e["kind"] == "rollback")
+        assert rb["reason"].startswith("non-finite")
+        assert rb["iteration"] == 1
+
+
+class TestPopulationTelemetry:
+    def test_pbt_run_emits_spans_and_exploit_events(self, tmp_path,
+                                                    capsys):
+        """The population loop speaks the same span protocol, plus
+        ``pbt_exploit`` rounds (who copied whom) on the timeline.
+        Shapes match test_cli's PBT test for compile-cache reuse."""
+        from rlgpuschedule_tpu import train as train_cli
+        obs = str(tmp_path / "obs")
+        train_cli.main(
+            ["--config", "hier-pbt-member", "--pbt", "--n-pop", "2",
+             "--pbt-ready", "1", "--iterations", "2", "--n-envs", "4",
+             "--n-nodes", "4", "--gpus-per-node", "4",
+             "--window-jobs", "16", "--log-every", "1",
+             "--horizon", "48", "--queue-len", "4", "--n-steps", "8",
+             "--n-epochs", "1", "--n-minibatches", "2",
+             "--obs-dir", obs])
+        capsys.readouterr()
+        events = merge_dir(obs)
+        kinds = [e["kind"] for e in events]
+        start = next(e for e in events if e["kind"] == "run_start")
+        assert start["loop"] == "population" and start["n_pop"] == 2
+        assert kinds.count("iteration") == 2
+        exploits = [e for e in events if e["kind"] == "pbt_exploit"]
+        assert len(exploits) >= 1
+        assert all(len(e["src"]) == 2 for e in exploits)
+        iters = [e for e in events if e["kind"] == "iteration"]
+        # flattened per-member metric columns ride the iteration event
+        assert all("mean_reward_mean" in e["metrics"] for e in iters)
+
+
+class TestReportCLI:
+    def _seed_dir(self, tmp_path) -> str:
+        d = str(tmp_path / "obs")
+        with EventBus(d, rank=0) as bus:
+            bus.emit("run_start", config="x")
+            bus.emit("iteration", iteration=0, wall_s=0.5,
+                     steps_per_sec=100.0, phases={"step": 0.4,
+                                                  "sync": 0.1},
+                     metrics={"total_loss": 0.1})
+            bus.emit("run_end")
+        return d
+
+    def test_report_exits_zero_and_prints_sections(self, tmp_path,
+                                                   capsys):
+        d = self._seed_dir(tmp_path)
+        assert report_cli.main([d]) == 0
+        out = capsys.readouterr().out
+        assert "phase-time table" in out
+        assert "steps/s curve" in out
+        assert "alarms:" in out and "(clean)" in out
+
+    def test_report_json_and_merged_out(self, tmp_path, capsys):
+        d = self._seed_dir(tmp_path)
+        merged = str(tmp_path / "merged.jsonl")
+        assert report_cli.main([d, "--json", "--out", merged]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["n_events"] == 3
+        assert rep["phase_seconds"]["step"] == pytest.approx(0.4)
+        lines = [json.loads(line) for line in open(merged)]
+        assert [e["kind"] for e in lines] == \
+            ["run_start", "iteration", "run_end"]
+
+    def test_strict_alarms_fails_on_recompile_event(self, tmp_path):
+        d = self._seed_dir(tmp_path)
+        assert report_cli.main([d, "--strict-alarms"]) == 0
+        with EventBus(d, rank=0) as bus:
+            bus.emit("recompile", iteration=7, events=2)
+        assert report_cli.main([d, "--strict-alarms"]) == 1
+
+    def test_missing_dir_exits_one(self, tmp_path):
+        assert report_cli.main([str(tmp_path / "nope")]) == 1
+
+
+class TestTrainCLIObs:
+    def test_alarms_require_obs_dir(self):
+        from rlgpuschedule_tpu import train as train_cli
+        with pytest.raises(SystemExit, match="--obs-dir"):
+            train_cli.main(["--config", "ppo-mlp-synth64", "--alarms"])
+
+    def test_train_obs_dir_produces_reportable_clean_timeline(
+            self, tmp_path, capsys):
+        """The CI smoke contract from the CLI surface: a short run with
+        --obs-dir + --alarms produces a merged timeline the report CLI
+        accepts with --strict-alarms (zero post-warmup recompiles)."""
+        from rlgpuschedule_tpu import train as train_cli
+        obs = str(tmp_path / "obs")
+        # same shapes as test_cli.FAST (compile-cache reuse)
+        train_cli.main(
+            ["--config", "ppo-mlp-synth64", "--iterations", "2",
+             "--n-envs", "4", "--n-nodes", "2", "--gpus-per-node", "4",
+             "--window-jobs", "16", "--log-every", "1", "--horizon",
+             "64", "--queue-len", "4", "--n-steps", "8", "--n-epochs",
+             "1", "--n-minibatches", "2", "--obs-dir", obs, "--alarms"])
+        capsys.readouterr()
+        assert report_cli.main([obs, "--strict-alarms"]) == 0
+        out = capsys.readouterr().out
+        assert "alarms:" in out
+        events = merge_dir(obs)
+        kinds = [e["kind"] for e in events]
+        assert "run_start" in kinds and "run_end" in kinds
+        assert kinds.count("iteration") == 2
+        assert os.path.exists(os.path.join(obs, "metrics.prom"))
