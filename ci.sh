@@ -52,7 +52,8 @@ echo "=== smoke: observability (3-iter CPU run + merged-timeline report) ==="
 # recompile/transfer alarms after warmup — --strict-alarms asserts both
 # in one exit code (ISSUE 5 acceptance).
 OBS_DIR=$(mktemp -d /tmp/ci_obs.XXXXXX)
-trap 'rm -rf "$OBS_DIR"' EXIT
+CHAOS_JSON=$(mktemp /tmp/ci_chaos.XXXXXX.json)
+trap 'rm -rf "$OBS_DIR" "$CHAOS_JSON"' EXIT
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python -m rlgpuschedule_tpu.train --config ppo-mlp-synth64 \
     --iterations 3 --n-envs 4 --n-nodes 2 --gpus-per-node 4 \
@@ -61,6 +62,28 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
     --obs-dir "$OBS_DIR" --alarms > /dev/null
 timeout -k 10 60 env JAX_PLATFORMS=cpu \
     python -m rlgpuschedule_tpu.obs.report "$OBS_DIR" --strict-alarms
+
+echo "=== smoke: chaos matrix (2 regimes x policy+SJF, CPU) ==="
+# ISSUE 6 acceptance: a tiny evaluate --chaos matrix must exit 0, keep
+# the no-jobs-lost conservation contract, and carry per-regime
+# degradation in its JSON (the satellite's chaos smoke stage)
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.evaluate --config ppo-mlp-synth64 \
+    --chaos --chaos-regimes sporadic --chaos-baselines sjf \
+    --n-envs 2 --n-nodes 2 --gpus-per-node 4 --window-jobs 16 \
+    --queue-len 4 --horizon 256 --max-steps 256 > "$CHAOS_JSON"
+python - "$CHAOS_JSON" <<'EOF'
+import json, sys
+rep = json.load(open(sys.argv[1]))
+assert rep["jobs_lost"] == 0, f"jobs lost under faults: {rep['jobs_lost']}"
+assert set(rep["regimes"]) == {"none", "sporadic"}, rep["regimes"].keys()
+for regime, rows in rep["regimes"].items():
+    for sched, row in rows.items():
+        assert row["degradation"] is not None, (regime, sched)
+assert rep["repro"]["chaos_seed"] == 0
+print("chaos smoke ok:", {r: round(rows["policy"]["degradation"], 3)
+                          for r, rows in rep["regimes"].items()})
+EOF
 
 echo "=== tier-1 pytest gate 1/2: main pass (ROADMAP.md, minus spawn) ==="
 rm -f /tmp/_t1.log

@@ -197,10 +197,10 @@ def make_train_step(apply_fn: PolicyApply, env_params: EnvParams,
         return state.apply_gradients(grads=grads)
 
     def train_step(train_state: TrainState, carry: RolloutCarry, traces,
-                   key: jax.Array):
+                   key: jax.Array, faults=None):
         carry, tr, last_value = rollout(apply_fn, train_state.params,
                                         env_params, traces, carry,
-                                        config.n_steps)
+                                        config.n_steps, faults)
         advantages, returns = compute_gae(tr.reward, tr.value, tr.done,
                                           last_value, config.gamma,
                                           config.gae_lambda)

@@ -46,26 +46,32 @@ class RolloutCarry(NamedTuple):
     key: jax.Array
 
 
-def init_carry(params: EnvParams, traces, key: jax.Array) -> RolloutCarry:
-    env_state, ts = env_lib.vec_reset(params, traces)
+def init_carry(params: EnvParams, traces, key: jax.Array,
+               faults=None) -> RolloutCarry:
+    env_state, ts = env_lib.vec_reset(params, traces, faults)
     return RolloutCarry(env_state, ts.obs, ts.action_mask, key)
 
 
 def rollout(apply_fn: PolicyApply, net_params, env_params: EnvParams,
-            traces, carry: RolloutCarry, n_steps: int,
+            traces, carry: RolloutCarry, n_steps: int, faults=None,
             ) -> tuple[RolloutCarry, Transition, jax.Array]:
     """Collect ``n_steps`` transitions from the vectorized envs in one scan.
-    Returns (carry', transitions [T,E,...], last_value [E])."""
-    # the auto-reset bundle depends only on the traces: build it once here
-    # (a scan constant) instead of re-running a full reset every step
-    fresh = env_lib.vec_reset(env_params, traces)
+    Returns (carry', transitions [T,E,...], last_value [E]).
+
+    ``faults``: batched per-env FaultSchedule threaded next to the traces
+    (auto-reset restarts an episode under the SAME schedule); None =
+    healthy cluster, the bit-identical pre-chaos program."""
+    # the auto-reset bundle depends only on the traces (and the fault
+    # schedules): build it once here (a scan constant) instead of
+    # re-running a full reset every step
+    fresh = env_lib.vec_reset(env_params, traces, faults)
 
     def step(c: RolloutCarry, _):
         logits, value = apply_fn(net_params, c.obs, c.mask)
         key, sub = jax.random.split(c.key)
         action, log_prob = action_dist.sample(sub, logits)
         env_state, ts = env_lib.vec_step(env_params, c.env_state, traces,
-                                         action, fresh)
+                                         action, fresh, faults)
         t = Transition(obs=c.obs, action=action, log_prob=log_prob,
                        value=value, reward=ts.reward, done=ts.done,
                        mask=c.mask, env_steps_dt=ts.info.dt)

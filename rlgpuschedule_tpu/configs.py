@@ -79,6 +79,13 @@ class ExperimentConfig:
     # plateaus at random-order quality (credit assignment: a placement's
     # JCT consequence lands hundreds of steps later).
     drain_frac: float = 0.0
+    # cluster chaos (sim.faults): train on a seeded in-simulator fault
+    # distribution — per-env FaultSchedules (node drains, drain storms,
+    # stragglers) sampled from this named regime (FAULT_REGIMES) and
+    # threaded through the rollout next to the traces. Flat configs also
+    # expose per-node health in the observation so the policy can LEARN
+    # to route around drains. None = permanently healthy cluster.
+    faults: str | None = None
 
     @property
     def total_gpus(self) -> int:

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from ..sim import core
 from ..sim.core import SimParams, SimState, Trace, StepInfo
+from ..sim.faults import FaultRegime, FaultSchedule
 from ..traces.records import ArrayTrace
 from . import obs as obs_lib
 from . import rewards as reward_lib
@@ -38,6 +39,24 @@ class EnvParams:
     place_bonus: float = 0.0      # potential-based shaping (rewards.py)
     preempt_cost: float = 0.0     # anti-stall preemption charge (rewards.py)
     horizon: int = 512            # max decision steps per episode
+    # cluster fault process (sim.faults): the static DISTRIBUTION the
+    # env's fault schedules are drawn from (the sampled FaultSchedule is
+    # per-env data threaded next to the trace). None = permanently
+    # healthy — the pre-chaos program, bit-identical.
+    fault_process: FaultRegime | None = None
+    # append a per-node health channel (1/slowdown while up, 0 while
+    # drained) to the observation so the policy can LEARN to route
+    # around drains. Flat observations only (the grid/graph encoders pin
+    # their channel/feature counts); checked in __post_init__.
+    fault_obs: bool = False
+
+    def __post_init__(self):
+        if self.fault_obs and self.obs_kind != "flat":
+            raise ValueError(
+                f"fault_obs appends per-node health to the FLAT "
+                f"observation; obs_kind={self.obs_kind!r} pins its "
+                f"feature layout (train grid/graph fault policies "
+                f"without health visibility, or use flat)")
 
     @property
     def n_actions(self) -> int:
@@ -46,7 +65,8 @@ class EnvParams:
     def obs_shape(self) -> tuple[int, ...]:
         s, k, r = self.sim, self.sim.queue_len, self.sim.preempt_len
         if self.obs_kind == "flat":
-            return (s.n_nodes + 4 * k + 4 * r + 2,)
+            n_health = s.n_nodes if self.fault_obs else 0
+            return (s.n_nodes + 4 * k + 4 * r + 2 + n_health,)
         if self.obs_kind == "grid":
             return (s.n_nodes + k + r, s.gpus_per_node, 2)
         return (s.n_nodes + k + r, obs_lib.GRAPH_FEATURES)
@@ -67,13 +87,23 @@ class TimeStep(NamedTuple):
 
 def build_obs(params: EnvParams, sim: SimState, trace: Trace,
               queue: jax.Array | None = None,
-              run_queue: jax.Array | None = None) -> jax.Array:
+              run_queue: jax.Array | None = None,
+              faults: FaultSchedule | None = None) -> jax.Array:
     fn = {"flat": obs_lib.flat_obs, "grid": obs_lib.grid_obs,
           "graph": obs_lib.graph_obs}[params.obs_kind]
-    return fn(params.sim, sim, trace, params.time_scale, queue, run_queue)
+    obs = fn(params.sim, sim, trace, params.time_scale, queue, run_queue)
+    if params.fault_obs:
+        # health appended LAST so the fault-free feature prefix is laid
+        # out identically to the pre-chaos observation; faults=None (a
+        # fault-trained policy replayed on a clean cluster) reads as
+        # every node healthy at full speed
+        obs = jnp.concatenate(
+            [obs, obs_lib.node_health(params.sim, sim, faults)])
+    return obs
 
 
 def _observe(params: EnvParams, sim: SimState, trace: Trace,
+             faults: FaultSchedule | None = None,
              ) -> tuple[jax.Array, jax.Array]:
     """(obs, action_mask) for ``sim``, computing the pending (and, for
     preemptive configs, running) queue once and sharing them between the
@@ -81,14 +111,16 @@ def _observe(params: EnvParams, sim: SimState, trace: Trace,
     queue = core.pending_queue(params.sim, sim)
     run_queue = (core.running_queue(params.sim, sim, trace)
                  if params.sim.preempt_len else None)
-    return (build_obs(params, sim, trace, queue, run_queue),
-            core.action_mask(params.sim, sim, trace, queue, run_queue))
+    return (build_obs(params, sim, trace, queue, run_queue, faults),
+            core.action_mask(params.sim, sim, trace, queue, run_queue,
+                             faults))
 
 
-def reset(params: EnvParams, trace: Trace) -> tuple[EnvState, TimeStep]:
+def reset(params: EnvParams, trace: Trace,
+          faults: FaultSchedule | None = None) -> tuple[EnvState, TimeStep]:
     sim = core.init_state(params.sim, trace)
     state = EnvState(sim=sim, t=jnp.int32(0))
-    obs, mask = _observe(params, sim, trace)
+    obs, mask = _observe(params, sim, trace, faults)
     ts = TimeStep(
         obs=obs,
         reward=jnp.float32(0.0),
@@ -103,9 +135,10 @@ def reset(params: EnvParams, trace: Trace) -> tuple[EnvState, TimeStep]:
 
 
 def step(params: EnvParams, state: EnvState, trace: Trace,
-         action: jax.Array) -> tuple[EnvState, TimeStep]:
+         action: jax.Array,
+         faults: FaultSchedule | None = None) -> tuple[EnvState, TimeStep]:
     sim_before = state.sim
-    sim, info = core.rl_step(params.sim, sim_before, trace, action)
+    sim, info = core.rl_step(params.sim, sim_before, trace, action, faults)
     if params.reward_kind == "fair":
         reward = reward_lib.reward_fair(sim_before, trace, info,
                                         params.n_tenants, params.reward_scale)
@@ -122,7 +155,7 @@ def step(params: EnvParams, state: EnvState, trace: Trace,
     t = state.t + 1
     done = info.done | (t >= params.horizon)
     new_state = EnvState(sim=sim, t=t)
-    obs, mask = _observe(params, sim, trace)
+    obs, mask = _observe(params, sim, trace, faults)
     ts = TimeStep(obs=obs, reward=reward, done=done, action_mask=mask,
                   info=info)
     return new_state, ts
@@ -144,14 +177,19 @@ def auto_reset(stepped_state, ts: TimeStep, fresh_state, fresh_ts: TimeStep,
 
 def auto_reset_step(params: EnvParams, state: EnvState, trace: Trace,
                     action: jax.Array, fresh=None,
+                    faults: FaultSchedule | None = None,
                     ) -> tuple[EnvState, TimeStep]:
-    """Step + fused auto-reset. The reset bundle depends only on the trace,
-    so callers stepping in a loop should compute ``fresh = reset(params,
-    trace)`` ONCE outside it and pass it here — recomputing a full reset
-    (init + obs + mask) every step was round 1's single largest hot-loop
-    redundancy (VERDICT r1 weak #2)."""
-    stepped, ts = step(params, state, trace, action)
-    fresh_state, fresh_ts = reset(params, trace) if fresh is None else fresh
+    """Step + fused auto-reset. The reset bundle depends only on the trace
+    (and fault schedule), so callers stepping in a loop should compute
+    ``fresh = reset(params, trace, faults)`` ONCE outside it and pass it
+    here — recomputing a full reset (init + obs + mask) every step was
+    round 1's single largest hot-loop redundancy (VERDICT r1 weak #2).
+    A mid-episode fault episode auto-resets the same way: the fresh
+    episode restarts at clock 0 under the SAME schedule (fault times are
+    episode-relative, like submits)."""
+    stepped, ts = step(params, state, trace, action, faults)
+    fresh_state, fresh_ts = (reset(params, trace, faults)
+                             if fresh is None else fresh)
     return auto_reset(stepped, ts, fresh_state, fresh_ts)
 
 
@@ -169,32 +207,46 @@ def stack_traces(traces: list[ArrayTrace],
 
 
 @functools.singledispatch
-def vec_reset(params, traces: Trace) -> tuple[Any, TimeStep]:
+def vec_reset(params, traces: Trace, faults=None) -> tuple[Any, TimeStep]:
     """Vectorized reset, dispatched on the params type (EnvParams here;
     env.hier registers HierParams) so the rollout/algorithms layer is
-    env-agnostic."""
+    env-agnostic. ``faults``: batched per-env FaultSchedule (leading axis
+    E, ``sim.faults.stack_fault_schedules``), or None = healthy."""
     raise TypeError(f"no env registered for params type {type(params)}")
 
 
 @functools.singledispatch
 def vec_step(params, state, traces: Trace, actions,
-             fresh=None) -> tuple[Any, TimeStep]:
+             fresh=None, faults=None) -> tuple[Any, TimeStep]:
     """Vectorized auto-reset step, dispatched on the params type. Pass
-    ``fresh = vec_reset(params, traces)`` when stepping in a loop so the
-    trace-constant reset bundle is built once, not per step."""
+    ``fresh = vec_reset(params, traces, faults)`` when stepping in a loop
+    so the trace-constant reset bundle is built once, not per step."""
     raise TypeError(f"no env registered for params type {type(params)}")
 
 
 @vec_reset.register
-def _(params: EnvParams, traces: Trace) -> tuple[EnvState, TimeStep]:
-    return jax.vmap(lambda tr: reset(params, tr))(traces)
+def _(params: EnvParams, traces: Trace,
+      faults=None) -> tuple[EnvState, TimeStep]:
+    if faults is None:
+        return jax.vmap(lambda tr: reset(params, tr))(traces)
+    return jax.vmap(lambda tr, f: reset(params, tr, f))(traces, faults)
 
 
 @vec_step.register
 def _(params: EnvParams, state: EnvState, traces: Trace,
-      actions: jax.Array, fresh=None) -> tuple[EnvState, TimeStep]:
+      actions: jax.Array, fresh=None,
+      faults=None) -> tuple[EnvState, TimeStep]:
+    if faults is None:
+        if fresh is None:
+            return jax.vmap(lambda s, tr, a: auto_reset_step(params, s, tr, a)
+                            )(state, traces, actions)
+        return jax.vmap(lambda s, tr, a, f: auto_reset_step(params, s, tr, a, f)
+                        )(state, traces, actions, fresh)
     if fresh is None:
-        return jax.vmap(lambda s, tr, a: auto_reset_step(params, s, tr, a)
-                        )(state, traces, actions)
-    return jax.vmap(lambda s, tr, a, f: auto_reset_step(params, s, tr, a, f)
-                    )(state, traces, actions, fresh)
+        return jax.vmap(
+            lambda s, tr, a, fl: auto_reset_step(params, s, tr, a,
+                                                 faults=fl)
+        )(state, traces, actions, faults)
+    return jax.vmap(
+        lambda s, tr, a, f, fl: auto_reset_step(params, s, tr, a, f, fl)
+    )(state, traces, actions, fresh, faults)

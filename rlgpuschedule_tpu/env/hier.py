@@ -386,13 +386,22 @@ def auto_reset_step(params: HierParams, state: HierState, trace: Trace,
 # ---- vectorization (rollout integration via singledispatch) -----------------
 
 @env_lib.vec_reset.register
-def _(params: HierParams, traces: Trace) -> tuple[HierState, TimeStep]:
+def _(params: HierParams, traces: Trace,
+      faults=None) -> tuple[HierState, TimeStep]:
+    if faults is not None:
+        raise ValueError("the hierarchical env has no fault-process "
+                         "support; cluster chaos (sim.faults) is a flat-"
+                         "config feature for now")
     return jax.vmap(lambda tr: reset(params, tr))(traces)
 
 
 @env_lib.vec_step.register
 def _(params: HierParams, state: HierState, traces: Trace,
-      actions: dict, fresh=None) -> tuple[HierState, TimeStep]:
+      actions: dict, fresh=None, faults=None) -> tuple[HierState, TimeStep]:
+    if faults is not None:
+        raise ValueError("the hierarchical env has no fault-process "
+                         "support; cluster chaos (sim.faults) is a flat-"
+                         "config feature for now")
     if fresh is None:
         return jax.vmap(lambda s, tr, a: auto_reset_step(params, s, tr, a)
                         )(state, traces, actions)

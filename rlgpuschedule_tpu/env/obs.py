@@ -13,6 +13,20 @@ import jax.numpy as jnp
 
 from ..sim.core import (SimParams, SimState, Trace, pending_queue,
                         running_queue, RUNNING, in_system, utilization)
+from ..sim.faults import FaultSchedule, node_up
+
+
+def node_health(params: SimParams, state: SimState,
+                faults: FaultSchedule | None = None) -> jax.Array:
+    """Per-node effective-speed feature [N]: 1.0 = healthy full speed,
+    ``1/slowdown`` = straggling, 0.0 = drained at the current clock — the
+    single channel a policy needs to route around sick nodes. With
+    ``faults=None`` (clean replay of a fault-trained policy) every node
+    reads healthy."""
+    if faults is None:
+        return jnp.ones((params.n_nodes,), jnp.float32)
+    up = node_up(faults, state.clock)
+    return jnp.where(up, 1.0 / faults.slowdown, 0.0).astype(jnp.float32)
 
 
 def queue_features(params: SimParams, state: SimState, trace: Trace,

@@ -26,7 +26,9 @@ from .env.env import EnvParams
 from .env.hier import HierParams
 from .sim import core
 from .sim.oracle import DONE as DONE_STATUS
+from .sim.oracle import NOT_ARRIVED as NOT_ARRIVED_STATUS
 from .sim.oracle import PENDING as PENDING_STATUS
+from .sim.oracle import RUNNING as RUNNING_STATUS
 from .sim.schedulers import run_baseline
 from .traces.records import ArrayTrace
 
@@ -142,7 +144,7 @@ def replay(apply_fn: Callable, net_params: Any,
            traces: core.Trace, max_steps: int | None = None,
            policy: str = "greedy", key: jax.Array | None = None,
            return_states: bool = False, backlog_gate: int = 0,
-           stall_guard: bool = True,
+           stall_guard: bool = True, faults: Any = None,
            ) -> "EvalResult | tuple[EvalResult, Any]":
     """Deterministically replay the batched trace windows under the policy
     (flat configs 1-4 and the hierarchical config 5 share this harness).
@@ -158,6 +160,12 @@ def replay(apply_fn: Callable, net_params: Any,
 
     ``backlog_gate``: >0 evaluates the backlog-gated HYBRID scheduler —
     see :func:`_gate_to_fifo` (flat configs only).
+
+    ``faults`` (flat configs): batched per-env ``sim.faults.FaultSchedule``
+    replayed next to the traces — the chaos matrix's policy side. A
+    faulty-cluster episode may legitimately end sub-100% complete (a
+    permanently-drained node can strand work); completion is part of the
+    reported degradation, not an error.
 
     ``stall_guard`` (preemptive configs, greedy replay only): break the
     measured place↔preempt argmax deadlock (BASELINE.md config-1p: 1 of 8
@@ -187,13 +195,20 @@ def replay(apply_fn: Callable, net_params: Any,
         raise ValueError("backlog_gate applies to flat configs (the "
                          "hierarchical action space has no single FIFO "
                          "fall-through action)")
+    if faults is not None and isinstance(env_params, HierParams):
+        raise ValueError("fault replay applies to flat configs (the "
+                         "hierarchical env has no fault-process support)")
     max_steps = int(max_steps or env_params.horizon)
     if key is None:
         key = jax.random.PRNGKey(0)
-    state, ts = env_lib.vec_reset(env_params, traces)
+    state, ts = env_lib.vec_reset(env_params, traces, faults)
 
     ops = _env_ops(env_params)
-    step_one = jax.vmap(ops.step)
+    if faults is None:
+        step_one = jax.vmap(ops.step)
+    else:
+        step_one = jax.vmap(
+            lambda s, tr, a, f: env_lib.step(env_params, s, tr, a, f))
     pre = (_preempt_slice(env_params)
            if stall_guard and policy == "greedy" else None)
     thresh = _stall_threshold(env_params) if pre is not None else 0
@@ -210,7 +225,9 @@ def replay(apply_fn: Callable, net_params: Any,
         if backlog_gate:
             actions = _gate_to_fifo(env_params, state.sim.status, mask,
                                     actions, backlog_gate)
-        new_state, new_ts = step_one(state, traces, actions)
+        new_state, new_ts = (step_one(state, traces, actions)
+                             if faults is None else
+                             step_one(state, traces, actions, faults))
         dt = jnp.where(done, 0.0, new_ts.info.dt)
         busy_time = busy_time + ops.busy(state) * dt
         stall = jnp.where(done | (new_ts.info.dt > 0.0), 0, stall + 1)
@@ -695,6 +712,167 @@ def full_trace_report(exp, max_jobs: int | None = None,
     if percentiles is not None:
         report["percentiles"] = pcts
     return report
+
+
+# ---- chaos evaluation matrix (ISSUE 6) --------------------------------------
+
+# the canonical regime axis of ``evaluate --chaos``: clean control,
+# uncorrelated background drains, correlated drain storms, stragglers
+CHAOS_REGIMES = ("none", "sporadic", "storm", "straggler")
+
+
+def _chaos_conservation(states, traces, env_params: EnvParams) -> dict:
+    """The no-jobs-lost contract over a batch of final replay states:
+    every node's ``free + allocated == capacity``, every RUNNING job holds
+    exactly its gang, every non-RUNNING job holds nothing, and every valid
+    job is in a legitimate lifecycle status — i.e. a drain KILLED jobs
+    back to the queue rather than leaking them or their GPUs. Returns
+    ``{"jobs_lost": int, "conserved": bool}``; the chaos matrix asserts
+    both."""
+    sim = jax.tree.map(np.asarray, states.sim)
+    tr = jax.tree.map(np.asarray, traces)
+    g = env_params.sim.gpus_per_node
+    node_ok = bool((sim.alloc.sum(axis=1) + sim.free == g).all())
+    alloc_j = sim.alloc.sum(axis=2)                       # [E, J]
+    running = sim.status == RUNNING_STATUS
+    run_ok = bool((alloc_j[running] == tr.gpus[running]).all())
+    idle_ok = bool((alloc_j[~running] == 0).all())
+    live = ((sim.status == NOT_ARRIVED_STATUS)
+            | (sim.status == PENDING_STATUS) | running
+            | (sim.status == DONE_STATUS))
+    lost = int(tr.valid.sum() - (tr.valid & live).sum())
+    return {"jobs_lost": lost,
+            "conserved": node_ok and run_ok and idle_ok and lost == 0}
+
+
+def chaos_report(exp, regimes: tuple[str, ...] = CHAOS_REGIMES,
+                 baselines: tuple[str, ...] = ("sjf", "tiresias"),
+                 max_steps: int | None = None, seed: int = 0,
+                 bus=None, registry=None) -> dict[str, Any]:
+    """The regime × scheduler chaos matrix (``evaluate --chaos``): replay
+    the trained policy AND the oracle baselines over the experiment's
+    windows under identical seeded fault schedules, one column per
+    scheduler, one row per fault regime, with **degradation vs clean**
+    (regime JCT / clean-regime JCT, per scheduler) as the headline —
+    "how much does each scheduler's JCT rot when the cluster starts
+    failing" is the robustness question this PR makes measurable.
+
+    The clean control ("none") is always evaluated (prepended when not
+    requested) because degradation is relative to it. Policy rows replay
+    the jitted env under batched per-env :class:`~.sim.faults.
+    FaultSchedule` data; baseline rows run the SAME per-window schedules
+    through the oracle event loop (``run_baseline(faults=...)``), so the
+    comparison is apples-to-apples per cell.
+
+    Every regime row enforces the no-jobs-lost conservation contract
+    (:func:`_chaos_conservation`) — a fault may delay work, never leak
+    it. Reproducibility tuple: ``(seed, regime name/params, window
+    batch)``; env ``e`` draws schedule ``(seed, e)``.
+
+    ``bus`` (:class:`obs.EventBus`) emits one ``env_fault`` event per
+    matrix cell plus per-regime schedule stats; ``registry``
+    (:class:`obs.Registry`) gains ``chaos_<regime>_<scheduler>_*``
+    gauges — the chaos story ``obs.report`` renders."""
+    from .sim.faults import (fault_horizon, resolve_regime,
+                             sample_fault_schedule, schedule_stats,
+                             stack_fault_schedules)
+    if isinstance(exp.env_params, HierParams):
+        raise ValueError("chaos evaluation supports flat configs (the "
+                         "hierarchical env has no fault-process support)")
+    env_params = exp.env_params
+    windows, traces = exp.windows, exp.traces
+    n_nodes, g = exp.cfg.n_nodes, exp.cfg.gpus_per_node
+    horizon_s = fault_horizon(windows)
+    regimes = list(dict.fromkeys(["none", *regimes]))
+    report: dict[str, Any] = {
+        "chaos_seed": int(seed), "fault_horizon_s": float(horizon_s),
+        "chaos_regimes": list(regimes), "jobs_lost": 0,
+        "regimes": {}, "fault_stats": {}}
+    for name in regimes:
+        regime = resolve_regime(name)
+        host = [sample_fault_schedule(n_nodes, regime, (seed, e),
+                                      horizon_s)
+                for e in range(len(windows))]
+        batched = stack_fault_schedules(host)
+        report["fault_stats"][name] = schedule_stats(batched)
+        res, states = replay(exp.apply_fn, exp.train_state.params,
+                             env_params, traces, max_steps,
+                             return_states=True, faults=batched)
+        cons = _chaos_conservation(states, traces, env_params)
+        if not cons["conserved"]:
+            raise AssertionError(
+                f"conservation violated under regime {name!r}: "
+                f"{cons} — a fault schedule must delay jobs, never "
+                f"leak them or their GPUs")
+        report["jobs_lost"] += cons["jobs_lost"]
+        jct, completion = pooled_avg_jct(res)
+        rows: dict[str, Any] = {
+            "policy": {"avg_jct": jct, "completion": completion}}
+        for bname in baselines:
+            jcts, n_valid = [], 0
+            for w, fs in zip(windows, host):
+                bl = run_baseline(w, n_nodes, g, bname, faults=fs)
+                jcts.append(bl.jcts())
+                n_valid += w.num_jobs
+            pooled = np.concatenate(jcts) if jcts else np.zeros(0)
+            rows[bname] = {
+                "avg_jct": float(pooled.mean()) if pooled.size else 0.0,
+                "completion": float(pooled.size / max(n_valid, 1))}
+        report["regimes"][name] = rows
+    clean = report["regimes"]["none"]
+    for name, rows in report["regimes"].items():
+        for sched, row in rows.items():
+            base = clean[sched]["avg_jct"]
+            row["degradation"] = (row["avg_jct"] / base
+                                  if base and np.isfinite(base) else None)
+    for name, rows in report["regimes"].items():
+        for sched, row in rows.items():
+            if bus is not None:
+                bus.emit("env_fault", regime=name, scheduler=sched,
+                         avg_jct=round(row["avg_jct"], 3),
+                         completion=round(row["completion"], 4),
+                         degradation=(round(row["degradation"], 4)
+                                      if row["degradation"] is not None
+                                      else None),
+                         chaos_seed=int(seed),
+                         **{f"fault_{k}": v for k, v in
+                            report["fault_stats"][name].items()})
+            if registry is not None:
+                stem = f"chaos_{name}_{sched}"
+                registry.gauge(f"{stem}_avg_jct").set(row["avg_jct"])
+                registry.gauge(f"{stem}_completion").set(
+                    row["completion"])
+                if row["degradation"] is not None:
+                    registry.gauge(f"{stem}_degradation").set(
+                        row["degradation"])
+    return report
+
+
+def format_chaos(report: dict[str, Any]) -> str:
+    """Human-readable chaos matrix: one row per regime, one column per
+    scheduler, each cell ``avg JCT [completion] ×degradation``."""
+    regimes = list(report["regimes"])
+    scheds = list(next(iter(report["regimes"].values())))
+    width = max(len("regime"), *(len(r) for r in regimes))
+    cell_w = 24
+    lines = [f"chaos matrix (seed {report['chaos_seed']}, fault horizon "
+             f"{report['fault_horizon_s']:.0f}s) — "
+             f"avg JCT s [completion] ×degradation-vs-clean:",
+             f"{'regime':<{width}}  " +
+             "  ".join(f"{s:<{cell_w}}" for s in scheds)]
+    for name in regimes:
+        cells = []
+        for s in scheds:
+            row = report["regimes"][name][s]
+            deg = (f"×{row['degradation']:.2f}"
+                   if row["degradation"] is not None else "×—")
+            cells.append(f"{row['avg_jct']:>8.1f} "
+                         f"[{row['completion']:>4.0%}] {deg:<7}")
+        lines.append(f"{name:<{width}}  " +
+                     "  ".join(f"{c:<{cell_w}}" for c in cells))
+    lines.append(f"jobs lost across the matrix: {report['jobs_lost']} "
+                 f"(conservation contract: must be 0)")
+    return "\n".join(lines)
 
 
 def jain_index(xs: np.ndarray) -> float:

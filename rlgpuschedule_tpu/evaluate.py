@@ -64,6 +64,36 @@ def build_parser() -> argparse.ArgumentParser:
                         "of the windows (all jobs at t=0) — the regime the "
                         "drain curriculum trains on; use 1.0 to reproduce "
                         "the BASELINE.md drain tables")
+    p.add_argument("--faults", default=None, metavar="REGIME",
+                   help="config override matching a --faults TRAINING run "
+                        "(the health channel is part of the checkpointed "
+                        "observation space — same contract as the "
+                        "cluster-shape overrides). Evaluation itself "
+                        "stays clean unless --chaos is passed")
+    p.add_argument("--chaos", action="store_true",
+                   help="chaos evaluation matrix: replay the policy AND "
+                        "the oracle baselines under identical seeded "
+                        "fault schedules across regimes (none/sporadic "
+                        "drains/drain storms/stragglers) and report "
+                        "per-regime avg JCT, completion, and DEGRADATION "
+                        "vs the clean regime — flat configs")
+    p.add_argument("--chaos-regimes", default=None, metavar="A,B,...",
+                   help="with --chaos: comma-separated regime subset "
+                        "(sim.faults.FAULT_REGIMES); the clean 'none' "
+                        "control is always included")
+    p.add_argument("--chaos-baselines", default="sjf,tiresias",
+                   metavar="A,B,...",
+                   help="with --chaos: baseline scheduler columns next "
+                        "to the policy (sim.schedulers.BASELINES)")
+    p.add_argument("--chaos-seed", type=int, default=0,
+                   help="with --chaos: base seed of the fault-schedule "
+                        "draws (env e draws (seed, e)); recorded in the "
+                        "JSON repro tuple")
+    p.add_argument("--obs-dir", default=None,
+                   help="with --chaos: emit per-cell env_fault events "
+                        "(JSONL event bus) and chaos_* gauges "
+                        "(metrics.prom) under this directory so "
+                        "obs.report can tell the chaos story")
     p.add_argument("--ckpt-dir", default=None,
                    help="restore the trained policy from this checkpoint "
                         "dir (omit = untrained init weights)")
@@ -156,7 +186,8 @@ def main(argv: list[str] | None = None) -> dict:
              "gpus_per_node": args.gpus_per_node,
              "window_jobs": args.window_jobs, "queue_len": args.queue_len,
              "horizon": args.horizon, "obs_kind": args.obs_kind,
-             "drain_frac": args.drain_frac}.items() if v is not None}
+             "drain_frac": args.drain_frac,
+             "faults": args.faults}.items() if v is not None}
     cfg = dataclasses.replace(cfg, **over)
     if args.source_jobs is not None:
         if args.source_jobs <= 0:
@@ -171,6 +202,48 @@ def main(argv: list[str] | None = None) -> dict:
     from .utils.platform import enable_compile_cache
 
     enable_compile_cache()
+
+    if args.chaos:
+        if (args.pbt or args.fairness or args.full_trace
+                or args.baselines_only or args.percentiles
+                or args.backlog_gate or cfg.n_pods > 1):
+            sys.exit("--chaos is its own regime × scheduler matrix over "
+                     "the window batch (flat configs): no --pbt/"
+                     "--fairness/--full-trace/--baselines-only/"
+                     "--percentiles/--backlog-gate")
+        if args.eval_windows is not None:
+            sys.exit("--chaos replays the experiment's window batch; "
+                     "size it with --n-envs")
+        from .sim.faults import FAULT_REGIMES
+        from .sim.schedulers import BASELINES
+        regimes = (tuple(s for s in args.chaos_regimes.split(",") if s)
+                   if args.chaos_regimes else None)
+        chaos_baselines = tuple(
+            s for s in args.chaos_baselines.split(",") if s)
+        bad = [r for r in (regimes or ()) if r not in FAULT_REGIMES]
+        if bad:
+            sys.exit(f"unknown --chaos-regimes {bad}; known: "
+                     f"{sorted(FAULT_REGIMES)}")
+        bad = [b for b in chaos_baselines if b not in BASELINES]
+        if bad:
+            sys.exit(f"unknown --chaos-baselines {bad}; known: "
+                     f"{sorted(BASELINES)}")
+    elif args.chaos_regimes is not None or args.obs_dir:
+        sys.exit("--chaos-regimes/--obs-dir configure the --chaos "
+                 "matrix; pass --chaos with them (refusing the silent "
+                 "no-op)")
+
+    # the full reproducibility tuple every evaluate JSON carries: enough
+    # to regenerate any row (chaos-matrix rows included) exactly —
+    # resolved checkpoint step filled in by restore() below
+    repro = {"config": cfg.name, "seed": cfg.seed, "trace": cfg.trace,
+             "trace_path": cfg.trace_path, "trace_load": cfg.trace_load,
+             "source_jobs": cfg.source_jobs, "n_envs": cfg.n_envs,
+             "n_nodes": cfg.n_nodes, "gpus_per_node": cfg.gpus_per_node,
+             "window_jobs": cfg.window_jobs, "queue_len": cfg.queue_len,
+             "horizon": cfg.horizon, "obs_kind": cfg.obs_kind,
+             "drain_frac": cfg.drain_frac, "faults": cfg.faults,
+             "ckpt_dir": args.ckpt_dir, "ckpt_step": None}
 
     if args.percentiles and (args.fairness or args.baselines_only
                              or args.pbt):
@@ -215,7 +288,7 @@ def main(argv: list[str] | None = None) -> dict:
         _, windows, _, _, _, _, _ = build_stack(cfg)
         report = baseline_jct_table(windows, cfg.n_nodes, cfg.gpus_per_node)
         print(format_report(report), file=sys.stderr)
-        print(json.dumps(report))
+        print(json.dumps({**report, "repro": repro}))
         return report
 
     def restore(target, label: str) -> None:
@@ -224,6 +297,9 @@ def main(argv: list[str] | None = None) -> dict:
             import os
             with Checkpointer(os.path.abspath(args.ckpt_dir)) as ckpt:
                 target.restore_checkpoint(ckpt, step=args.ckpt_step)
+                # resolved, not requested: the integrity fallback may
+                # restore an older retained step than asked for
+                repro["ckpt_step"] = ckpt.last_restored_step
             print(f"{label} restored from {args.ckpt_dir}", file=sys.stderr)
         else:
             print("note: no --ckpt-dir; evaluating untrained init weights",
@@ -245,6 +321,33 @@ def main(argv: list[str] | None = None) -> dict:
     else:
         exp = Experiment.build(cfg)
         restore(exp, "policy")
+    if args.chaos:
+        import os
+
+        from .eval import CHAOS_REGIMES, chaos_report, format_chaos
+        bus = registry = None
+        if args.obs_dir:
+            from .obs import EventBus, Registry
+            bus = EventBus(os.path.abspath(args.obs_dir), rank=0,
+                           name="chaos")
+            registry = Registry()
+        try:
+            report = chaos_report(
+                exp, regimes=regimes or CHAOS_REGIMES,
+                baselines=chaos_baselines, max_steps=args.max_steps,
+                seed=args.chaos_seed, bus=bus, registry=registry)
+        finally:
+            if bus is not None:
+                bus.close()
+        if registry is not None:
+            registry.write(os.path.join(os.path.abspath(args.obs_dir),
+                                        "metrics.prom"))
+        print(format_chaos(report), file=sys.stderr)
+        report["repro"] = dict(repro, chaos_seed=args.chaos_seed,
+                               chaos_regimes=report["chaos_regimes"],
+                               chaos_baselines=list(chaos_baselines))
+        print(json.dumps(report))
+        return report
     if args.fairness:
         report = fairness_report(exp, max_steps=args.max_steps)
         print(format_fairness(report), file=sys.stderr)
@@ -261,7 +364,7 @@ def main(argv: list[str] | None = None) -> dict:
             if isinstance(v, list):
                 return [_json_safe(x) for x in v]
             return v
-        print(json.dumps(_json_safe(report)))
+        print(json.dumps(_json_safe({**report, "repro": repro})))
         return report
     if args.full_trace:
         stitch_params = None
@@ -307,6 +410,7 @@ def main(argv: list[str] | None = None) -> dict:
     out = {k: v for k, v in report.items() if isinstance(v, (int, float))}
     if "percentiles" in report:
         out["percentiles"] = report["percentiles"]
+    out["repro"] = repro
     print(json.dumps(out))
     return report
 

@@ -20,6 +20,8 @@ from .configs import ExperimentConfig
 from .env import EnvParams, build_adjacency, stack_traces
 from .models import make_policy
 from .sim.core import SimParams, validate_trace
+from .sim.faults import (fault_horizon, resolve_regime,
+                         sample_env_fault_schedules)
 from .traces import (ArrayTrace, gen_poisson_trace, load_pai, load_philly)
 from flax.training.train_state import TrainState
 
@@ -29,11 +31,19 @@ def build_env_params(cfg: ExperimentConfig) -> EnvParams:
                     max_jobs=cfg.window_jobs, queue_len=cfg.queue_len,
                     n_placements=cfg.n_placements,
                     preempt_len=cfg.preempt_len)
+    fault_process = resolve_regime(cfg.faults) if cfg.faults else None
     return EnvParams(sim=sim, obs_kind=cfg.obs_kind,
                      reward_kind=cfg.reward_kind, n_tenants=cfg.n_tenants,
                      time_scale=cfg.time_scale, reward_scale=cfg.reward_scale,
                      place_bonus=cfg.place_bonus,
-                     preempt_cost=cfg.preempt_cost, horizon=cfg.horizon)
+                     preempt_cost=cfg.preempt_cost, horizon=cfg.horizon,
+                     fault_process=fault_process,
+                     # per-node health rides the FLAT observation only
+                     # (grid/graph pin their feature layouts); those
+                     # encoders still train on fault dynamics, blind to
+                     # which node is sick
+                     fault_obs=(fault_process is not None
+                                and cfg.obs_kind == "flat"))
 
 
 def load_source_trace(cfg: ExperimentConfig, n_jobs: int | None = None,
@@ -79,6 +89,10 @@ def build_stack(cfg: ExperimentConfig):
     if cfg.n_pods > 1:
         from .env import hier as hier_lib   # registers the vec dispatch
         from .models.hier import HierActorCritic
+        if cfg.faults:
+            raise ValueError(
+                "hierarchical configs have no fault-process support yet "
+                "(sim.faults is a flat-config feature); unset faults")
         if cfg.n_nodes % cfg.n_pods != 0:
             raise ValueError(f"n_nodes={cfg.n_nodes} not divisible by "
                              f"n_pods={cfg.n_pods}")
@@ -192,12 +206,24 @@ class Experiment:
     window_cursor: int = 0   # first window index of the current env batch
     train_step_raw: Callable | None = None   # unjitted (for run_fused)
     _fused_jit: Callable | None = None       # lazy; jit caches per length
+    # batched per-env sim.faults.FaultSchedule [E, ...] (cfg.faults), or
+    # None = healthy cluster. DATA like the traces: threaded through the
+    # jitted step as an argument, never closed over, so schedules can
+    # change without recompiling
+    faults: Any = None
 
     @staticmethod
     def build(cfg: ExperimentConfig, axis_name: str | None = None,
               jit: bool = True) -> "Experiment":
         env_params, windows, traces, net, apply_fn, extra, source = \
             build_stack(cfg)
+        faults = None
+        if getattr(env_params, "fault_process", None) is not None:
+            # seeded per-env draws over the window batch's time span, so
+            # drain windows intersect live episodes at every trace scale
+            faults = sample_env_fault_schedules(
+                cfg.n_nodes, env_params.fault_process, cfg.seed,
+                cfg.n_envs, fault_horizon(windows))
         key = jax.random.PRNGKey(cfg.seed)
         key, init_key, carry_key = jax.random.split(key, 3)
         algo_cfg = cfg.ppo if cfg.algo == "ppo" else cfg.a2c
@@ -214,7 +240,7 @@ class Experiment:
             from .algos.a2c import make_optimizer as a2c_opt
             tx = a2c_opt(algo_cfg)
             step_fn = make_a2c_step(apply_fn, env_params, algo_cfg, axis_name)
-        carry = init_carry(env_params, traces, carry_key)
+        carry = init_carry(env_params, traces, carry_key, faults)
         ex_obs, ex_mask = jax.tree.map(lambda x: x[:1],
                                        (carry.obs, carry.mask))
         train_state = make_train_state(net, init_key, ex_obs, ex_mask, tx,
@@ -238,7 +264,7 @@ class Experiment:
                           traces=traces, net=net, apply_fn=apply_fn,
                           train_state=train_state, train_step=jit_step,
                           carry=carry, key=key, source=source,
-                          train_step_raw=step_fn)
+                          train_step_raw=step_fn, faults=faults)
 
     @property
     def steps_per_iteration(self) -> int:
@@ -278,10 +304,10 @@ class Experiment:
                     "build; a jit=False/axis_name experiment runs its "
                     "step under parallel.dp.shard_map_train instead")
 
-            def many(state, carry, traces, keys):
+            def many(state, carry, traces, keys, faults):
                 def body(c, sk):
                     s, ca = c
-                    s, ca, _ = step(s, ca, traces, sk)
+                    s, ca, _ = step(s, ca, traces, sk, faults)
                     return (s, ca), None
 
                 (state, carry), _ = jax.lax.scan(
@@ -289,7 +315,7 @@ class Experiment:
                 # final step outside the scan returns its metrics without
                 # stacking [k] metric arrays for the whole run
                 state, carry, metrics = step(state, carry, traces,
-                                             keys[-1])
+                                             keys[-1], faults)
                 return state, carry, metrics
 
             # one wrapper; jax.jit itself caches one compile per distinct
@@ -298,7 +324,7 @@ class Experiment:
         self.key, sub = jax.random.split(self.key)
         keys = jax.random.split(sub, iterations)
         self.train_state, self.carry, metrics = self._fused_jit(
-            self.train_state, self.carry, self.traces, keys)
+            self.train_state, self.carry, self.traces, keys, self.faults)
         return metrics
 
     def _cut_windows(self, cursor: int) -> None:
@@ -320,10 +346,14 @@ class Experiment:
     def advance_windows(self) -> None:
         """Rotate every env onto the next ``n_envs`` windows of the source
         tiling and reset episodes (window streaming — a long run covers
-        the whole trace, VERDICT r1 missing #3)."""
+        the whole trace, VERDICT r1 missing #3). Fault schedules are
+        window-independent (episode-relative times) and stay fixed: a
+        streaming run sees every window under its env's draw of the
+        fault distribution."""
         self._cut_windows(self.window_cursor + self.cfg.n_envs)
         self.key, carry_key = jax.random.split(self.key)
-        carry = init_carry(self.env_params, self.traces, carry_key)
+        carry = init_carry(self.env_params, self.traces, carry_key,
+                           self.faults)
         self.carry = jax.tree.map(
             lambda new, old: jax.device_put(new, old.sharding),
             carry, self.carry)
@@ -486,7 +516,8 @@ class Experiment:
                 self.key, sub = jax.random.split(self.key)
                 with sections("step"), guard:
                     self.train_state, self.carry, metrics = self.train_step(
-                        self.train_state, self.carry, self.traces, sub)
+                        self.train_state, self.carry, self.traces, sub,
+                        self.faults)
             if injector is not None:
                 metrics = injector.poison_nan(self, b, metrics)
             log_hit = log_every and (
@@ -595,6 +626,11 @@ class PopulationExperiment:
                 f"PopulationExperiment trains PPO members (PBT explores "
                 f"PPO hyperparameters); config {cfg.name!r} has "
                 f"algo={cfg.algo!r}")
+        if cfg.faults:
+            raise ValueError(
+                "PopulationExperiment does not thread fault schedules "
+                "through the vmapped member step yet; train chaos "
+                "policies on single-run configs (cfg.faults=None)")
         pbt_cfg = pbt_cfg or PBTConfig(seed=cfg.seed)
         resolve_geometry(cfg.ppo.n_epochs, cfg.ppo.n_minibatches,
                          cfg.ppo.minibatch_size,

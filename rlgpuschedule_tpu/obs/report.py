@@ -11,6 +11,8 @@ timeline and prints the run's post-mortem:
   decisions, watchdog rollbacks, checkpoint save/restore/reject events
   and fault injections, in timeline order;
 - steps/s curve (one row per logged iteration);
+- chaos story (``env_fault`` events from ``evaluate --chaos``): the
+  regime × scheduler degradation cells, in one table;
 - alarm summary (``recompile`` / ``transfer`` / ``slow_iteration``).
 
 Exit codes: 0 ok, 1 no events under the directory (an empty post-mortem
@@ -61,6 +63,13 @@ def build_report(events: list[dict]) -> dict:
 
     history = [e for e in events if e.get("kind") in _HISTORY_KINDS]
     restores = [e for e in events if e.get("kind") == "ckpt_restore"]
+    chaos = [{"regime": e.get("regime"), "scheduler": e.get("scheduler"),
+              "avg_jct": e.get("avg_jct"),
+              "completion": e.get("completion"),
+              "degradation": e.get("degradation"),
+              "n_drains": e.get("fault_n_drains"),
+              "chaos_seed": e.get("chaos_seed")}
+             for e in events if e.get("kind") == "env_fault"]
     alarms = {k: sum(1 for e in events if e.get("kind") == k)
               for k in ALARM_KINDS}
     counts: dict[str, int] = {}
@@ -71,7 +80,7 @@ def build_report(events: list[dict]) -> dict:
             "n_events": len(events), "span_s": span_s, "t0_mono": t0,
             "phase_seconds": phases, "steps_curve": curve,
             "history": history, "ckpt_restores": restores,
-            "alarms": alarms, "kind_counts": counts}
+            "chaos": chaos, "alarms": alarms, "kind_counts": counts}
 
 
 def _fmt_history_line(e: dict, t0: float) -> str:
@@ -119,6 +128,23 @@ def format_report(rep: dict) -> str:
                 f"{row.get('rank', 0):>4} "
                 f"{(f'{sps:.1f}' if sps is not None else '?'):>12s} "
                 f"{(f'{wall:.4f}' if wall is not None else '?'):>12s}")
+        lines.append("")
+    if rep.get("chaos"):
+        lines.append("chaos story (env_fault events, evaluate --chaos):")
+        lines.append(f"  {'regime':<12s} {'scheduler':<10s} "
+                     f"{'avg JCT s':>10s} {'done':>6s} {'vs clean':>9s} "
+                     f"{'drains':>7s}")
+        for c in rep["chaos"]:
+            deg = c.get("degradation")
+            done = c.get("completion")
+            jct = c.get("avg_jct")
+            lines.append(
+                f"  {str(c.get('regime')):<12s} "
+                f"{str(c.get('scheduler')):<10s} "
+                f"{(f'{jct:.1f}' if jct is not None else '?'):>10s} "
+                f"{(f'{done:.0%}' if done is not None else '?'):>6s} "
+                f"{(f'x{deg:.2f}' if deg is not None else '—'):>9s} "
+                f"{str(c.get('n_drains', '?')):>7s}")
         lines.append("")
     alarm_total = sum(rep["alarms"].values())
     lines.append(

@@ -1,10 +1,19 @@
-"""L1 simulator layer: exact Python oracle + jit/vmap JAX core."""
+"""L1 simulator layer: exact Python oracle + jit/vmap JAX core + the
+seeded cluster fault process (chaos engine)."""
+from .faults import (FAULT_REGIMES, FaultRegime, FaultSchedule,
+                     fault_schedule_from_events, no_faults,
+                     sample_fault_schedule, sample_env_fault_schedules,
+                     stack_fault_schedules, validate_fault_schedule)
 from .oracle import (OracleSim, pack_placement, spread_placement,
                      NOT_ARRIVED, PENDING, RUNNING, DONE, PACK, SPREAD)
 from .schedulers import (SchedulerPolicy, fifo, sjf, srtf, tiresias,
                          BASELINES, run_scheduler, evaluate_baselines)
 
 __all__ = [
+    "FAULT_REGIMES", "FaultRegime", "FaultSchedule",
+    "fault_schedule_from_events", "no_faults", "sample_fault_schedule",
+    "sample_env_fault_schedules", "stack_fault_schedules",
+    "validate_fault_schedule",
     "OracleSim", "pack_placement", "spread_placement",
     "NOT_ARRIVED", "PENDING", "RUNNING", "DONE", "PACK", "SPREAD",
     "SchedulerPolicy", "fifo", "sjf", "srtf", "tiresias",

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..traces.records import ArrayTrace
+from .faults import (FaultSchedule, effective_free, job_stretch, next_transition,
+                     node_up, validate_fault_schedule)
 from .oracle import NOT_ARRIVED, PENDING, RUNNING, DONE, PACK, SPREAD
 
 INF = jnp.inf
@@ -76,13 +78,20 @@ class Trace(NamedTuple):
 
 
 def validate_trace(params: SimParams, tr: ArrayTrace, clamp: bool = False,
-                   ) -> ArrayTrace:
+                   faults: "FaultSchedule | None" = None) -> ArrayTrace:
     """Host-side guard mirroring OracleSim's constructor check: a valid job
     demanding more GPUs than the cluster has can never be placed, and inside
     the jitted sim that surfaces as a silently frozen episode (no exception
     can be raised from traced code). Raise here instead — or, with
     ``clamp=True``, cap demands at capacity (useful when replaying a big
-    production trace on a small debug cluster)."""
+    production trace on a small debug cluster).
+
+    ``faults``: also validate a fault schedule against the cluster shape
+    (drain windows sorted, durations positive, node count matching — see
+    :func:`~.faults.validate_fault_schedule`), so the trace and its chaos
+    script are vetted at the same ingest point."""
+    if faults is not None:
+        validate_fault_schedule(params.n_nodes, faults)
     over = tr.valid & (tr.gpus > params.capacity)
     if not over.any():
         return tr
@@ -146,25 +155,55 @@ def _process_arrivals(state: SimState, trace: Trace) -> SimState:
 
 # ---- events -----------------------------------------------------------------
 
-def next_event_time(state: SimState, trace: Trace) -> jax.Array:
-    """Earliest future arrival or completion; +inf if neither (masked min —
-    the vectorized replacement for the oracle's priority queue)."""
+def next_event_time(state: SimState, trace: Trace,
+                    faults: "FaultSchedule | None" = None) -> jax.Array:
+    """Earliest future arrival, completion, or fault transition; +inf if
+    none (masked min — the vectorized replacement for the oracle's
+    priority queue). With ``faults``, completions are slowdown-stretched
+    (a gang finishes at ``clock + remaining × stretch``) and every drain
+    start / node return is an event, so the decision loop stops AT each
+    transition and :func:`advance_to` never integrates across one."""
     arrival = jnp.min(jnp.where(state.status == NOT_ARRIVED, trace.submit, INF))
-    completion = jnp.min(jnp.where(state.status == RUNNING,
-                                   state.clock + state.remaining, INF))
-    return jnp.minimum(arrival, completion)
+    running = state.status == RUNNING
+    if faults is None:
+        eta = state.clock + state.remaining
+    else:
+        eta = state.clock + state.remaining * job_stretch(faults, state.alloc)
+    completion = jnp.min(jnp.where(running, eta, INF))
+    t = jnp.minimum(arrival, completion)
+    if faults is not None:
+        t = jnp.minimum(t, next_transition(faults, state.clock))
+    return t
 
 
-def advance_to(state: SimState, trace: Trace, t: jax.Array) -> SimState:
+def advance_to(state: SimState, trace: Trace, t: jax.Array,
+               faults: "FaultSchedule | None" = None) -> SimState:
     """Advance the clock to ``t`` (caller guarantees t ≤ next event; +inf is
     a no-op). Completions at ``t`` are processed before arrivals, matching
-    ``OracleSim.advance_to``."""
+    ``OracleSim.advance_to``.
+
+    With ``faults``: running work progresses at ``1/stretch`` (straggler
+    nodes stretch remaining service; ``next_event_time`` uses the same
+    stretched expression, so the completion-tolerance argument below is
+    unchanged), and — after completions, before arrivals — every job still
+    holding an allocation on a node that is down at ``t`` is killed back
+    to PENDING with its attained service preserved (checkpointed
+    preemption; the job is never lost). The caller contract "t ≤ next
+    event" now also means "never advance across a fault transition":
+    ``next_event_time`` includes transitions, so ``rl_step`` stops at the
+    drain instant and the kill happens exactly there."""
     finite = jnp.isfinite(t)
     t = jnp.where(finite, t, state.clock)
     dt = t - state.clock
     running = state.status == RUNNING
-    remaining = jnp.where(running,
-                          jnp.maximum(state.remaining - dt, 0.0),
+    if faults is None:
+        progressed = state.remaining - dt
+        eta = state.clock + state.remaining
+    else:
+        stretch = job_stretch(faults, state.alloc)
+        progressed = state.remaining - dt / stretch
+        eta = state.clock + state.remaining * stretch
+    remaining = jnp.where(running, jnp.maximum(progressed, 0.0),
                           state.remaining)
     # Completion test on absolute completion time with an ulp-scaled
     # tolerance: at large clocks the f32 spacing of ``clock + remaining``
@@ -175,7 +214,7 @@ def advance_to(state: SimState, trace: Trace, t: jax.Array) -> SimState:
     # than f32 time resolution itself (1e-5·|t| would complete jobs seconds
     # early on Philly-scale clocks).
     tol = _EPS + 4.0 * jnp.spacing(t)
-    completed = running & (state.clock + state.remaining <= t + tol)
+    completed = running & (eta <= t + tol)
     released = jnp.sum(state.alloc * completed[:, None].astype(jnp.int32), axis=0)
     state = SimState(
         clock=t,
@@ -186,7 +225,28 @@ def advance_to(state: SimState, trace: Trace, t: jax.Array) -> SimState:
         alloc=jnp.where(completed[:, None], 0, state.alloc),
         free=state.free + released,
     )
+    if faults is not None:
+        state = _kill_drained(state, faults)
     return _process_arrivals(state, trace)
+
+
+def _kill_drained(state: SimState, faults: FaultSchedule) -> SimState:
+    """RUNNING → PENDING for every job holding an allocation on a node
+    that is down at ``state.clock``; GPUs return to ``free`` so the
+    per-node conservation invariant (free + allocated == capacity) holds
+    at every instant. Idempotent and branch-free: a pure mask over
+    (alloc, node_up) — re-applying it at a later step while the node is
+    still down is a no-op because killed jobs hold no allocation."""
+    up = node_up(faults, state.clock)
+    killed = (state.status == RUNNING) & jnp.any(
+        (state.alloc > 0) & ~up[None, :], axis=1)
+    released = jnp.sum(state.alloc * killed[:, None].astype(jnp.int32),
+                       axis=0)
+    return state._replace(
+        status=jnp.where(killed, PENDING, state.status),
+        alloc=jnp.where(killed[:, None], 0, state.alloc),
+        free=state.free + released,
+    )
 
 
 # ---- placement (matches oracle.pack_placement / spread_placement) ----------
@@ -241,13 +301,19 @@ def placement(free: jax.Array, demand: jax.Array, mode: jax.Array,
 # ---- scheduling actions -----------------------------------------------------
 
 def try_place(params: SimParams, state: SimState, trace: Trace,
-              j: jax.Array, mode: jax.Array) -> tuple[SimState, jax.Array]:
+              j: jax.Array, mode: jax.Array,
+              faults: "FaultSchedule | None" = None,
+              ) -> tuple[SimState, jax.Array]:
     """Gang-place job row ``j`` (traced index; -1 = invalid). Returns
-    (state', success). All-or-nothing: infeasible → state unchanged."""
+    (state', success). All-or-nothing: infeasible → state unchanged.
+    With ``faults``, placement sees drained nodes as zero free capacity
+    (:func:`~.faults.effective_free`), so a gang can never land on a
+    down node."""
     jc = jnp.clip(j, 0, params.max_jobs - 1)
     pending = (j >= 0) & (state.status[jc] == PENDING)
     demand = trace.gpus[jc]
-    alloc, feasible = placement(state.free, demand, mode, params.gpus_per_node,
+    free = effective_free(faults, state.free, state.clock)
+    alloc, feasible = placement(free, demand, mode, params.gpus_per_node,
                                 params.n_placements)
     ok = pending & feasible
     allocd = jnp.where(ok, alloc, 0)
@@ -330,17 +396,21 @@ def attained_service(state: SimState, trace: Trace) -> jax.Array:
 
 def action_mask(params: SimParams, state: SimState, trace: Trace,
                 queue: jax.Array | None = None,
-                run_queue: jax.Array | None = None) -> jax.Array:
+                run_queue: jax.Array | None = None,
+                faults: "FaultSchedule | None" = None) -> jax.Array:
     """bool[n_actions]: queue-slot actions valid iff the slot holds a pending
     job whose gang fits in the free GPUs (pack and spread share feasibility:
     jobs may span nodes); preempt slots valid iff they hold a running job;
     no-op is always valid. Pass precomputed ``pending_queue`` /
-    ``running_queue`` to share them with the observation builder."""
+    ``running_queue`` to share them with the observation builder. With
+    ``faults``, feasibility counts only up nodes' free GPUs — the mask and
+    :func:`try_place` always agree on what fits."""
     if queue is None:
         queue = pending_queue(params, state)                   # [K]
     jc = jnp.clip(queue, 0, params.max_jobs - 1)
     demand = trace.gpus[jc]
-    ok = (queue >= 0) & (demand <= jnp.sum(state.free))        # [K]
+    free = effective_free(faults, state.free, state.clock)
+    ok = (queue >= 0) & (demand <= jnp.sum(free))              # [K]
     slots = jnp.repeat(ok, params.n_placements)                # [K*P]
     parts = [slots]
     if params.preempt_len:
@@ -354,7 +424,8 @@ def action_mask(params: SimParams, state: SimState, trace: Trace,
 # ---- the RL decision-point step --------------------------------------------
 
 def rl_step(params: SimParams, state: SimState, trace: Trace,
-            action: jax.Array) -> tuple[SimState, StepInfo]:
+            action: jax.Array, faults: "FaultSchedule | None" = None,
+            ) -> tuple[SimState, StepInfo]:
     """One decision-point step; exact jit/vmap analogue of
     ``OracleSim.rl_step`` (see its docstring for the semantics). Branchless:
     every outcome (placement vs preemption vs time-advance) is computed and
@@ -364,7 +435,13 @@ def rl_step(params: SimParams, state: SimState, trace: Trace,
     and preemptions cost no simulated time (the agent acts again at the
     same instant); preemption targets ``running_queue`` slots. The R block
     exists only when ``params.preempt_len > 0``, so non-preemptive configs
-    trace the exact same XLA program as before."""
+    trace the exact same XLA program as before.
+
+    ``faults`` (a :class:`~.faults.FaultSchedule`, or None = permanently
+    healthy) threads the cluster fault process through placement
+    feasibility, event selection, progress stretching, and drain kills —
+    it is DATA: stepping under a different schedule of the same shape
+    reuses the compiled program (CompileCounter-asserted)."""
     K, P, R = params.queue_len, params.n_placements, params.preempt_len
     n_place = K * P
     queue = pending_queue(params, state)
@@ -373,7 +450,7 @@ def rl_step(params: SimParams, state: SimState, trace: Trace,
     mode = action % P
     j = jnp.where(is_place, queue[k], -1)
 
-    placed_state, placed = try_place(params, state, trace, j, mode)
+    placed_state, placed = try_place(params, state, trace, j, mode, faults)
 
     if R:
         run_q = running_queue(params, state, trace)
@@ -389,13 +466,16 @@ def rl_step(params: SimParams, state: SimState, trace: Trace,
     # event horizon is empty (nothing running ⇒ cluster free ⇒ feasible for
     # any job with demand ≤ capacity — validate_trace enforces that on host;
     # an over-capacity job would make forced_ok False and the episode can
-    # only end via the env horizon).
-    t_next = next_event_time(state, trace)
+    # only end via the env horizon). Under faults an exhausted event
+    # horizon additionally implies no transition is pending, so any still-
+    # drained node is drained FOREVER; a job that no longer fits the
+    # surviving capacity makes forced_ok False the same way.
+    t_next = next_event_time(state, trace, faults)
     has_event = jnp.isfinite(t_next)
     n_before = in_system(state)
-    advanced_state = advance_to(state, trace, t_next)
+    advanced_state = advance_to(state, trace, t_next, faults)
     forced_state, forced_ok = try_place(params, state, trace, queue[0],
-                                        jnp.int32(PACK))
+                                        jnp.int32(PACK), faults)
 
     if R:
         def pick(a, p, b, c):

@@ -1,0 +1,334 @@
+"""Seeded, jit-pure cluster fault process (L1) — the in-simulator chaos
+engine.
+
+``sim.core`` models nodes as permanently healthy capacity; this module
+makes node failure a first-class, *data-driven* part of the simulation:
+
+- :class:`FaultSchedule` — a precomputed, trace-like pytree of per-node
+  drain windows and slowdown factors. Like :class:`~.core.Trace`, it is
+  DATA, not code: the jitted step takes it as an argument, so stepping
+  under two different schedules of the same shape traces and compiles
+  exactly once (the Jumanji scalable-env recipe — randomize over a fault
+  distribution without touching the XLA program; CompileCounter-asserted
+  in tests/test_sim_faults.py).
+- branch-free consumption helpers (:func:`node_up`,
+  :func:`next_transition`, :func:`job_stretch`) that ``core.advance_to``
+  / ``core.try_place`` fold into their existing ``jnp.where`` masks, so
+  ``jit``/``vmap``/``scan`` and the vec-env keep working unchanged.
+- seeded host-side *regimes* (:data:`FAULT_REGIMES`) — none / sporadic
+  drains / correlated drain storms / stragglers — sampled by
+  :func:`sample_fault_schedule` for training (``train --faults``) and the
+  chaos evaluation matrix (``evaluate --chaos``).
+
+Semantics (mirrored exactly by ``sim.oracle.OracleSim``):
+
+- A node is **down** on every half-open interval
+  ``[down_start, down_end)`` of its row. While down, its free GPUs are
+  invisible to placement (capacity masked to zero) and any job holding
+  an allocation on it is killed back to the PENDING queue at the drain
+  instant — *never lost*: attained service is preserved (the sim's
+  checkpointed-preemption model), and the job re-enters the queue for
+  re-placement once capacity exists. Conservation (``free + allocated ==
+  capacity`` per node, no job vanishing) is a tested invariant.
+- A **straggler** node has ``slowdown > 1``: remaining work on it
+  stretches by that factor, and a gang spanning several nodes runs at
+  its *slowest* node's speed (all-or-nothing gang semantics).
+- Drain starts and node returns are events: ``core.next_event_time``
+  includes the next transition, so the decision loop always stops AT a
+  transition and never integrates across one.
+
+This is the *simulated cluster's* fault layer — what the learned
+scheduler experiences and can learn to route around. The *training
+harness's* fault layer (process kills, NaN grads, corrupt checkpoints)
+is ``resilience.FaultInjector``; see README "Cluster chaos" for the
+distinction.
+"""
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class FaultSchedule(NamedTuple):
+    """Per-node fault trace (fixed shapes; ``W`` = drain windows per node,
+    +inf padding). Rows are sorted by ``down_start`` ascending —
+    :func:`validate_fault_schedule` enforces it, mirroring the submit-
+    sorted contract of :class:`~.core.Trace`."""
+    down_start: jax.Array  # f32[N, W] drain instants (+inf = unused slot)
+    down_end: jax.Array    # f32[N, W] return instants (+inf = never)
+    slowdown: jax.Array    # f32[N]    work-stretch factor (1.0 = healthy)
+
+    @property
+    def n_nodes(self) -> int:
+        return int(self.down_start.shape[-2])
+
+
+def no_faults(n_nodes: int, n_waves: int = 1) -> FaultSchedule:
+    """The permanently-healthy schedule (host arrays) — the shape-
+    compatible identity element, so clean and chaotic regimes share one
+    compiled program."""
+    return FaultSchedule(
+        down_start=np.full((n_nodes, n_waves), np.inf, np.float32),
+        down_end=np.full((n_nodes, n_waves), np.inf, np.float32),
+        slowdown=np.ones((n_nodes,), np.float32))
+
+
+# ---- branch-free consumption (jit/vmap-safe) --------------------------------
+
+def node_up(faults: FaultSchedule, t: jax.Array) -> jax.Array:
+    """bool[N]: node is serving at time ``t`` (down on [start, end))."""
+    down = jnp.any((faults.down_start <= t) & (t < faults.down_end),
+                   axis=-1)
+    return ~down
+
+
+def next_transition(faults: FaultSchedule, t: jax.Array) -> jax.Array:
+    """Earliest drain-start or node-return strictly after ``t`` (+inf if
+    none) — a fault transition is an event: state changes discontinuously
+    (drain kills jobs; return restores capacity), so the decision loop
+    must stop there."""
+    times = jnp.stack([faults.down_start, faults.down_end])
+    return jnp.min(jnp.where(times > t, times, jnp.inf))
+
+
+def job_stretch(faults: FaultSchedule, alloc: jax.Array) -> jax.Array:
+    """f32[J] per-job work-stretch factor: a gang runs at its SLOWEST
+    node's speed (all-or-nothing gang semantics), 1.0 for jobs holding no
+    allocation."""
+    on = alloc > 0                                        # [J, N]
+    return jnp.max(jnp.where(on, faults.slowdown[None, :], 1.0), axis=1)
+
+
+def effective_free(faults: "FaultSchedule | None", free: jax.Array,
+                   t: jax.Array) -> jax.Array:
+    """Placement's view of the free-GPU vector: drained nodes offer
+    zero capacity. ``faults=None`` is the healthy fast path (identity)."""
+    if faults is None:
+        return free
+    return jnp.where(node_up(faults, t), free, 0)
+
+
+# ---- host-side validation (fail-fast, mirrors validate_trace) ---------------
+
+def validate_fault_schedule(n_nodes: int, faults: FaultSchedule,
+                            ) -> FaultSchedule:
+    """Host-side guard mirroring :func:`~.core.validate_trace`: inside the
+    jitted sim a malformed schedule (end before start, unsorted windows)
+    cannot raise — it surfaces as silently wrong drain masks. Raise here
+    instead, fail-fast with the offending field named. Returns the
+    schedule as host numpy arrays."""
+    start = np.asarray(faults.down_start, np.float32)
+    end = np.asarray(faults.down_end, np.float32)
+    slow = np.asarray(faults.slowdown, np.float32)
+    if start.ndim != 2 or start.shape != end.shape:
+        raise ValueError(
+            f"fault schedule wants down_start/down_end of matching shape "
+            f"[n_nodes, n_waves]; got {start.shape} vs {end.shape}")
+    if start.shape[0] != n_nodes or slow.shape != (n_nodes,):
+        raise ValueError(
+            f"fault schedule is shaped for {start.shape[0]} node(s) with "
+            f"slowdown {slow.shape}; the cluster has {n_nodes}")
+    finite = np.isfinite(start)
+    if (start[finite] < 0).any():
+        raise ValueError("drain start times must be >= 0")
+    if np.isnan(start).any() or np.isnan(end).any():
+        raise ValueError("fault schedule times must not be NaN")
+    if (end[finite] <= start[finite]).any():
+        raise ValueError(
+            "drain durations must be positive (down_end > down_start "
+            "for every finite drain window)")
+    if (np.isfinite(end) & ~finite).any():
+        raise ValueError("a node-return time without a matching drain "
+                         "start (finite down_end under +inf down_start)")
+    # +inf padding maps to fmax so inf-inf never produces a NaN diff and
+    # padding BEFORE a finite window still reads as unsorted
+    bounded = np.where(finite, start, np.finfo(np.float32).max)
+    if (np.diff(bounded, axis=1) < 0).any():
+        raise ValueError("per-node drain windows must be sorted by start "
+                         "time (pad with +inf at the tail)")
+    if (~np.isfinite(slow)).any() or (slow < 1.0).any():
+        raise ValueError("slowdown factors must be finite and >= 1.0 "
+                         "(1.0 = healthy; a speed-UP is not a fault)")
+    return FaultSchedule(start, end, slow)
+
+
+def fault_schedule_from_events(n_nodes: int, node: Sequence[int],
+                               start: Sequence[float],
+                               duration: Sequence[float],
+                               slowdown: "Sequence[float] | None" = None,
+                               n_waves: "int | None" = None,
+                               ) -> FaultSchedule:
+    """Pack an event list (node id, drain start, outage duration) into the
+    per-node array form, validating as it goes — the trace-like ingest
+    path for hand-written or externally-sourced chaos scripts."""
+    node = np.asarray(node, np.int64)
+    start = np.asarray(start, np.float64)
+    duration = np.asarray(duration, np.float64)
+    if not (node.shape == start.shape == duration.shape):
+        raise ValueError("node/start/duration must have matching lengths")
+    if node.size and (node.min() < 0 or node.max() >= n_nodes):
+        raise ValueError(
+            f"drain event node id(s) out of range [0, {n_nodes})")
+    if (duration <= 0).any():
+        raise ValueError("drain durations must be positive")
+    if (start < 0).any():
+        raise ValueError("drain start times must be >= 0")
+    per_node = max((np.bincount(node, minlength=n_nodes).max()
+                    if node.size else 0), 1)
+    W = int(n_waves) if n_waves is not None else int(per_node)
+    if per_node > W:
+        raise ValueError(f"{int(per_node)} drain window(s) on one node "
+                         f"exceed n_waves={W}")
+    fs = no_faults(n_nodes, W)
+    for n in range(n_nodes):
+        mine = node == n
+        order = np.argsort(start[mine], kind="stable")
+        s = start[mine][order]
+        fs.down_start[n, :len(s)] = s
+        fs.down_end[n, :len(s)] = s + duration[mine][order]
+    if slowdown is not None:
+        fs = fs._replace(slowdown=np.asarray(slowdown, np.float32))
+    return validate_fault_schedule(n_nodes, fs)
+
+
+# ---- seeded fault regimes ---------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FaultRegime:
+    """A named fault DISTRIBUTION (static + hashable — it can live inside
+    ``EnvParams``); :func:`sample_fault_schedule` draws concrete seeded
+    :class:`FaultSchedule` data from it. Times are expressed as fractions
+    of the episode horizon so one regime transfers across trace scales."""
+    name: str
+    p_drain: float = 0.0         # per-node chance of drain window(s)
+    n_waves: int = 1             # drain windows per drained node (W)
+    outage_frac: float = 0.12    # mean outage length / horizon
+    storm: bool = False          # correlated starts: one instant per wave
+    p_straggler: float = 0.0     # per-node chance of a slowdown factor
+    slowdown_min: float = 1.5
+    slowdown_max: float = 4.0
+
+
+# The chaos matrix's canonical regimes (ISSUE 6): a clean control, the
+# uncorrelated single-drain background rate, the correlated many-nodes-
+# at-once storm (recovery-storm pressure), and pure stragglers.
+FAULT_REGIMES: dict[str, FaultRegime] = {
+    "none": FaultRegime("none"),
+    "sporadic": FaultRegime("sporadic", p_drain=0.25),
+    "storm": FaultRegime("storm", p_drain=0.6, n_waves=2,
+                         outage_frac=0.08, storm=True),
+    "straggler": FaultRegime("straggler", p_straggler=0.4),
+}
+
+
+def resolve_regime(regime: "FaultRegime | str") -> FaultRegime:
+    if isinstance(regime, FaultRegime):
+        return regime
+    if regime not in FAULT_REGIMES:
+        raise ValueError(f"unknown fault regime {regime!r}; known: "
+                         f"{sorted(FAULT_REGIMES)}")
+    return FAULT_REGIMES[regime]
+
+
+def sample_fault_schedule(n_nodes: int, regime: "FaultRegime | str",
+                          seed, horizon_s: float) -> FaultSchedule:
+    """One seeded host-side draw from ``regime`` over ``[0, horizon_s)``.
+
+    ``seed`` may be an int or a tuple of ints (e.g. ``(base_seed, env)``);
+    the regime name is folded in too, so the same base seed yields
+    independent draws per regime — the reproducibility tuple recorded by
+    ``evaluate --chaos`` is exactly ``(seed, regime, n_nodes,
+    horizon_s)``."""
+    regime = resolve_regime(regime)
+    if not (np.isfinite(horizon_s) and horizon_s > 0):
+        raise ValueError(f"horizon_s must be finite and > 0, got "
+                         f"{horizon_s}")
+    entropy = list(seed) if isinstance(seed, (tuple, list)) else [int(seed)]
+    rng = np.random.default_rng([zlib.crc32(regime.name.encode()),
+                                 *[int(s) & 0xFFFFFFFF for s in entropy]])
+    W = max(int(regime.n_waves), 1)
+    fs = no_faults(n_nodes, W)
+    drained = rng.random(n_nodes) < regime.p_drain
+    mean_outage = max(regime.outage_frac * horizon_s, 1e-3)
+    for w in range(W):
+        # storms correlate: every drained node fails within a tight jitter
+        # of one storm instant (recovery-storm pressure on the scheduler);
+        # sporadic drains start independently anywhere in the window
+        if regime.storm:
+            base = rng.uniform(0.1, 0.6) * horizon_s
+            starts = base + rng.exponential(0.01 * horizon_s,
+                                            size=n_nodes)
+        else:
+            starts = rng.uniform(0.05, 0.7, size=n_nodes) * horizon_s
+        outages = np.maximum(rng.exponential(mean_outage, size=n_nodes),
+                             1e-3)
+        fs.down_start[:, w] = np.where(drained, starts, np.inf)
+        fs.down_end[:, w] = np.where(drained, starts + outages, np.inf)
+    # re-sort each node's windows by start (wave draws are unordered)
+    order = np.argsort(fs.down_start, axis=1, kind="stable")
+    fs = FaultSchedule(np.take_along_axis(fs.down_start, order, axis=1),
+                       np.take_along_axis(fs.down_end, order, axis=1),
+                       fs.slowdown)
+    straggler = rng.random(n_nodes) < regime.p_straggler
+    fs.slowdown[:] = np.where(
+        straggler,
+        rng.uniform(regime.slowdown_min, regime.slowdown_max,
+                    size=n_nodes), 1.0).astype(np.float32)
+    return validate_fault_schedule(n_nodes, fs)
+
+
+def sample_env_fault_schedules(n_nodes: int, regime: "FaultRegime | str",
+                               seed: int, n_envs: int, horizon_s: float,
+                               ) -> FaultSchedule:
+    """Batched device schedules [E, ...] for the vec-env: env ``e`` draws
+    from ``(seed, e)``, so the batch covers the regime's distribution
+    rather than replaying one draw E times."""
+    return stack_fault_schedules(
+        [sample_fault_schedule(n_nodes, regime, (seed, e), horizon_s)
+         for e in range(n_envs)])
+
+
+def stack_fault_schedules(schedules: Sequence[FaultSchedule],
+                          ) -> FaultSchedule:
+    """Stack per-env schedules into a batched device FaultSchedule
+    (leading axis E) — the fault twin of ``env.stack_traces``."""
+    return jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                        *schedules)
+
+
+def schedule_stats(faults: FaultSchedule) -> dict:
+    """Host summary of one (or a batched) schedule — what the chaos
+    matrix's ``env_fault`` events carry so ``obs.report`` can tell the
+    story without re-deriving it from arrays."""
+    start = np.asarray(faults.down_start, np.float64)
+    end = np.asarray(faults.down_end, np.float64)
+    slow = np.asarray(faults.slowdown, np.float64)
+    finite = np.isfinite(start)
+    bounded = finite & np.isfinite(end)
+    return {
+        "n_drains": int(finite.sum()),
+        "n_permanent": int((finite & ~np.isfinite(end)).sum()),
+        "total_downtime_s": float((end[bounded] - start[bounded]).sum()),
+        "n_stragglers": int((slow > 1.0).sum()),
+        "max_slowdown": float(slow.max()) if slow.size else 1.0,
+    }
+
+
+def fault_horizon(windows) -> float:
+    """Rough sim-time span of a window set — the interval fault windows
+    should land inside so drains actually intersect live episodes. Spans
+    the arrival process plus a few mean service times of drain tail."""
+    t = 0.0
+    for w in windows:
+        valid = np.asarray(w.valid)
+        if not valid.any():
+            continue
+        submit = np.asarray(w.submit, np.float64)[valid]
+        duration = np.asarray(w.duration, np.float64)[valid]
+        t = max(t, float(submit.max()) + 4.0 * float(duration.mean()))
+    return max(t, 1.0)

@@ -74,10 +74,18 @@ def spread_placement(free: np.ndarray, demand: int) -> np.ndarray | None:
 
 
 class OracleSim:
-    """Exact discrete-event simulation of one cluster over one trace."""
+    """Exact discrete-event simulation of one cluster over one trace.
+
+    ``faults`` (a :class:`~.faults.FaultSchedule`, validated at
+    construction) attaches the cluster fault process — the same
+    semantics the jitted ``sim.core`` implements branch-free (and is
+    property-tested against): drained nodes offer zero placement
+    capacity and kill their running jobs back to PENDING with attained
+    service preserved; straggler nodes stretch remaining work; drain
+    starts and node returns are events."""
 
     def __init__(self, trace: ArrayTrace | list[JobRecord], n_nodes: int,
-                 gpus_per_node: int):
+                 gpus_per_node: int, faults=None):
         if isinstance(trace, list):
             from ..traces.records import to_array_trace
             trace = to_array_trace(trace)
@@ -87,6 +95,10 @@ class OracleSim:
         self.capacity = n_nodes * gpus_per_node
         if trace.num_jobs and int(trace.gpus[trace.valid].max()) > self.capacity:
             raise ValueError("a job demands more GPUs than the cluster has")
+        self.faults = None
+        if faults is not None:
+            from .faults import validate_fault_schedule
+            self.faults = validate_fault_schedule(n_nodes, faults)
         self.reset()
 
     def reset(self):
@@ -107,28 +119,62 @@ class OracleSim:
         arrived = (self.status == NOT_ARRIVED) & (self.trace.submit <= self.clock)
         self.status[arrived] = PENDING
 
+    def node_up(self, t: float | None = None) -> np.ndarray:
+        """bool[N]: nodes serving at ``t`` (down on [start, end))."""
+        if self.faults is None:
+            return np.ones(self.n_nodes, bool)
+        t = self.clock if t is None else t
+        f = self.faults
+        return ~((np.asarray(f.down_start) <= t)
+                 & (t < np.asarray(f.down_end))).any(axis=1)
+
+    def effective_free(self) -> np.ndarray:
+        """Placement's view of free GPUs: drained nodes offer zero."""
+        if self.faults is None:
+            return self.free
+        return np.where(self.node_up(), self.free, 0).astype(self.free.dtype)
+
+    def _stretch(self) -> np.ndarray:
+        """f64[J] per-job work-stretch: a gang runs at its slowest node's
+        speed; 1.0 with no faults or no allocation."""
+        if self.faults is None:
+            return np.ones(self.trace.max_jobs)
+        slow = np.asarray(self.faults.slowdown, np.float64)
+        return np.where(self.alloc > 0, slow[None, :], 1.0).max(axis=1)
+
     def next_event_time(self) -> float:
-        """Earliest future arrival or completion; +inf if neither exists."""
+        """Earliest future arrival, completion, or fault transition; +inf
+        if none exists."""
         t = np.inf
         na = self.status == NOT_ARRIVED
         if na.any():
             t = min(t, float(self.trace.submit[na].min()))
         run = self.status == RUNNING
         if run.any():
-            t = min(t, self.clock + float(self.remaining[run].min()))
+            eta = self.remaining[run] * self._stretch()[run]
+            t = min(t, self.clock + float(eta.min()))
+        if self.faults is not None:
+            times = np.concatenate([
+                np.asarray(self.faults.down_start, np.float64).ravel(),
+                np.asarray(self.faults.down_end, np.float64).ravel()])
+            future = times[times > self.clock]
+            if future.size:
+                t = min(t, float(future.min()))
         return t
 
     def advance_to(self, t: float) -> float:
         """Advance the clock to ``t`` (≤ next event time; schedulers may pass
         an earlier timer wake, e.g. a Tiresias demotion instant). Completions
-        falling exactly on ``t`` are processed before arrivals. Returns dt."""
+        falling exactly on ``t`` are processed before arrivals; drain kills
+        (jobs on nodes down at ``t`` back to PENDING, service preserved)
+        land between the two, matching ``sim.core.advance_to``. Returns dt."""
         if not np.isfinite(t):
             return 0.0
         if t > self.next_event_time() + 1e-9:
             raise ValueError("advance_to would skip over an event")
         dt = t - self.clock
         run = self.status == RUNNING
-        self.remaining[run] -= dt
+        self.remaining[run] -= dt / self._stretch()[run]
         self.clock = t
         completed = run & (self.remaining <= 1e-9)
         for j in np.flatnonzero(completed):
@@ -137,6 +183,14 @@ class OracleSim:
             self.remaining[j] = 0.0
             self.free += self.alloc[j]
             self.alloc[j] = 0
+        if self.faults is not None:
+            down = ~self.node_up()
+            killed = (self.status == RUNNING) & \
+                ((self.alloc > 0) & down[None, :]).any(axis=1)
+            for j in np.flatnonzero(killed):
+                self.free += self.alloc[j]
+                self.alloc[j] = 0
+                self.status[j] = PENDING
         self._process_arrivals()
         return dt
 
@@ -147,11 +201,14 @@ class OracleSim:
     # ---- scheduling actions ------------------------------------------------
 
     def try_place(self, j: int, mode: int = PACK) -> bool:
-        """Gang-place pending job j; returns False if infeasible/not pending."""
+        """Gang-place pending job j; returns False if infeasible/not
+        pending. Placement sees drained nodes as zero free capacity, so a
+        gang can never land on a down node."""
         if self.status[j] != PENDING:
             return False
         demand = int(self.trace.gpus[j])
-        place = (pack_placement if mode == PACK else spread_placement)(self.free, demand)
+        place = (pack_placement if mode == PACK
+                 else spread_placement)(self.effective_free(), demand)
         if place is None:
             return False
         self.alloc[j] = place
@@ -209,10 +266,14 @@ class OracleSim:
             if np.isfinite(t):
                 dt = self.advance_to(t)
             elif queue:
+                # may legitimately fail under faults: an exhausted event
+                # horizon with a permanently-drained node can leave the
+                # head job larger than the surviving capacity (matches
+                # sim.core's forced_ok=False path — the episode then only
+                # ends via the env horizon)
                 first = bool(np.isnan(self.start[queue[0]]))
-                assert self.try_place(queue[0], PACK)
-                placed = True
-                first_placed = first
+                placed = self.try_place(queue[0], PACK)
+                first_placed = placed and first
         return {"placed": placed, "dt": dt, "in_system_before": n_before,
                 "done": self.done(), "preempted": preempted,
                 "first_placed": first_placed}

@@ -112,7 +112,10 @@ def schedule_step(sim: OracleSim, policy: SchedulerPolicy,
         # Greedy prefix admission: walk the priority order, keep/place while
         # the gang fits. Anything running but not admitted is preempted first
         # so its GPUs are available to higher-priority jobs.
-        budget = int(sim.free.sum()) + sum(int(sim.trace.gpus[j]) for j in sim.running_jobs())
+        # effective_free: drained nodes offer no capacity (running gangs
+        # only ever occupy up nodes — drains evict them at the transition)
+        budget = int(sim.effective_free().sum()) + \
+            sum(int(sim.trace.gpus[j]) for j in sim.running_jobs())
         admitted = []
         for j in order:
             d = int(sim.trace.gpus[j])
@@ -150,7 +153,7 @@ def run_scheduler(sim: OracleSim, policy: SchedulerPolicy,
 
 
 def run_baseline(trace, n_nodes: int, gpus_per_node: int, name: str,
-                 backend: str = "auto") -> BaselineResult:
+                 backend: str = "auto", faults=None) -> BaselineResult:
     """Run one named baseline over a trace; returns the finished sim (the
     single implementation behind every baseline JCT table).
 
@@ -158,10 +161,20 @@ def run_baseline(trace, n_nodes: int, gpus_per_node: int, name: str,
     ~100× the Python oracle on production-scale traces) when a toolchain is
     present, falling back to the oracle; "python" / "native" force one.
     Both backends implement identical semantics (cross-validated in
-    tests/test_native.py) and return the :class:`BaselineResult` surface."""
+    tests/test_native.py) and return the :class:`BaselineResult` surface.
+
+    ``faults`` (a :class:`~.faults.FaultSchedule`) runs the baseline on a
+    faulty cluster — the chaos matrix's apples-to-apples comparison
+    against the policy replayed under the SAME schedule. The native
+    engine has no fault model, so faults force the Python oracle
+    (``backend="native"`` + faults is refused rather than silently
+    diverging)."""
     if backend not in ("auto", "python", "native"):
         raise ValueError(f"unknown backend {backend!r}")
-    if backend != "python":
+    if faults is not None and backend == "native":
+        raise ValueError("the native backend has no fault model; run "
+                         "faulty-cluster baselines on the python oracle")
+    if backend != "python" and faults is None:
         from .. import native
         if native.available():
             from ..traces.records import ArrayTrace, to_array_trace
@@ -173,7 +186,7 @@ def run_baseline(trace, n_nodes: int, gpus_per_node: int, name: str,
         if backend == "native":
             raise RuntimeError(
                 f"native backend unavailable: {native.build_error()}")
-    sim = OracleSim(trace, n_nodes, gpus_per_node)
+    sim = OracleSim(trace, n_nodes, gpus_per_node, faults=faults)
     return run_scheduler(sim, BASELINES[name]())
 
 

@@ -69,6 +69,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="backlog-drain curriculum: fraction of envs that "
                         "train on drained copies of their windows (all "
                         "jobs at t=0)")
+    p.add_argument("--faults", default=None, metavar="REGIME",
+                   help="cluster chaos: train on a seeded in-simulator "
+                        "fault distribution — per-env node-drain/"
+                        "straggler schedules (sim.faults.FAULT_REGIMES: "
+                        "none/sporadic/storm/straggler) threaded through "
+                        "the rollout next to the traces; flat configs "
+                        "also expose per-node health in the observation. "
+                        "Evaluate the result with evaluate --chaos")
     # algorithm hyperparameter overrides (apply to the active algo's
     # config — cfg.ppo or cfg.a2c; None = keep preset value). Large-batch
     # TPU runs typically want a higher --lr than the preset 3e-4, which
@@ -213,7 +221,7 @@ def apply_overrides(cfg: ExperimentConfig,
               "trace_load": args.trace_load,
               "source_jobs": args.source_jobs,
               "resample_every": args.resample_every,
-              "drain_frac": args.drain_frac}
+              "drain_frac": args.drain_frac, "faults": args.faults}
     cfg = dataclasses.replace(
         cfg, **{k: v for k, v in fields.items() if v is not None})
     algo_fields = {"lr": args.lr, "ent_coef": args.ent_coef,
@@ -393,6 +401,14 @@ def main(argv: list[str] | None = None) -> dict:
         if not args.ckpt_dir:
             sys.exit("--max-rollbacks requires --ckpt-dir (rollback "
                      "restores the last good checkpoint)")
+    if args.faults is not None:
+        from .sim.faults import FAULT_REGIMES
+        if args.faults not in FAULT_REGIMES:
+            sys.exit(f"unknown --faults regime {args.faults!r}; known: "
+                     f"{sorted(FAULT_REGIMES)}")
+        if args.pbt:
+            sys.exit("--faults applies to single-run configs (the "
+                     "population step does not thread fault schedules)")
     if args.alarms and not args.obs_dir:
         sys.exit("--alarms requires --obs-dir (alarm events need an "
                  "event stream to land in)")

@@ -373,6 +373,56 @@ class TestEvaluateCLI:
         assert "policy" in report and "tiresias" in report
         assert np.isfinite(report["policy"])
 
+    def test_repro_tuple_in_json_output(self, capsys):
+        # ISSUE 6 satellite: every evaluate JSON carries the full
+        # reproducibility tuple (seed, scenario params, checkpoint step)
+        evaluate_cli.main(
+            ["--config", "ppo-mlp-synth64", "--n-envs", "2", "--no-random",
+             "--n-nodes", "2", "--gpus-per-node", "4", "--window-jobs",
+             "16", "--horizon", "64", "--max-steps", "64", "--seed", "5"])
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        repro = out["repro"]
+        assert repro["seed"] == 5 and repro["config"] == "ppo-mlp-synth64"
+        assert {"trace", "n_nodes", "gpus_per_node", "window_jobs",
+                "faults", "ckpt_dir", "ckpt_step"} <= set(repro)
+        assert repro["ckpt_step"] is None   # untrained init weights
+
+    def test_chaos_matrix_cli(self, capsys):
+        # the ISSUE 6 acceptance shape: regime x scheduler degradation
+        # matrix on CPU, conservation holding, repro tuple attached
+        report = evaluate_cli.main(
+            ["--config", "ppo-mlp-synth64", "--chaos",
+             "--chaos-regimes", "sporadic", "--chaos-baselines", "sjf",
+             "--n-envs", "2", "--n-nodes", "2", "--gpus-per-node", "4",
+             "--window-jobs", "16", "--queue-len", "4",
+             "--horizon", "256", "--max-steps", "256"])
+        assert set(report["regimes"]) == {"none", "sporadic"}
+        assert report["jobs_lost"] == 0
+        row = report["regimes"]["sporadic"]["policy"]
+        assert np.isfinite(row["avg_jct"]) and row["degradation"] >= 0
+        assert report["repro"]["chaos_seed"] == 0
+        err = capsys.readouterr().err
+        assert "chaos matrix" in err and "degradation" in err
+
+    def test_chaos_flag_refusals(self):
+        with pytest.raises(SystemExit):   # chaos sub-flag without --chaos
+            evaluate_cli.main(["--config", "ppo-mlp-synth64",
+                               "--chaos-regimes", "storm"])
+        with pytest.raises(SystemExit):   # incompatible mode
+            evaluate_cli.main(["--config", "ppo-mlp-synth64", "--chaos",
+                               "--baselines-only"])
+        with pytest.raises(SystemExit):   # unknown regime, named early
+            evaluate_cli.main(["--config", "ppo-mlp-synth64", "--chaos",
+                               "--chaos-regimes", "meteor"])
+
+    def test_train_faults_refusals(self):
+        with pytest.raises(SystemExit):   # unknown regime
+            train_cli.main(["--config", "ppo-mlp-synth64", *FAST,
+                            "--faults", "meteor"])
+        with pytest.raises(SystemExit):   # population path unsupported
+            train_cli.main(["--config", "ppo-mlp-synth64", *FAST,
+                            "--faults", "sporadic", "--pbt"])
+
 
 class TestMinibatchSweep:
     """profile_breakdown --sweep-minibatch: the automated geometry lever

@@ -1,0 +1,472 @@
+"""Cluster chaos engine (ISSUE 6): the in-simulator fault process.
+
+Three contracts pin the tentpole:
+
+1. **Oracle parity under faults** — the jitted branch-free fault path
+   (drain kills, straggler stretch, fault-transition events, masked
+   placement) reproduces ``OracleSim(faults=...)`` trajectory-for-
+   trajectory on integer-valued traces/schedules (f32-exact, same
+   regime as tests/test_sim_core.py).
+2. **Conservation invariants** — at EVERY step of random action
+   sequences, with and without faults/preemption: per-node
+   ``free + allocated == capacity``, RUNNING jobs hold exactly their
+   gang, everything else holds nothing, and no valid job ever leaves
+   the NOT_ARRIVED/PENDING/RUNNING/DONE lifecycle (a drain delays jobs,
+   never loses them).
+3. **Schedules are data, not code** — stepping under two different
+   FaultSchedules of the same shape must not retrace the jitted step
+   (CompileCounter-asserted; the zero-recompile contract the whole
+   vec-env/scan stack depends on).
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from rlgpuschedule_tpu.sim import core as C
+from rlgpuschedule_tpu.sim import faults as F
+from rlgpuschedule_tpu.sim import oracle as O
+from rlgpuschedule_tpu.sim.schedulers import run_baseline
+from rlgpuschedule_tpu.traces import JobRecord, to_array_trace
+
+
+def int_trace(rng, n_jobs, max_gpus, max_jobs=None):
+    """Random integer-valued trace (exact in float32)."""
+    jobs, t = [], 0
+    for i in range(n_jobs):
+        t += int(rng.integers(0, 30))
+        jobs.append(JobRecord(i, float(t), float(rng.integers(1, 50)),
+                              int(rng.integers(1, max_gpus + 1)),
+                              int(rng.integers(0, 3))))
+    return to_array_trace(jobs, max_jobs=max_jobs)
+
+
+def int_faults(rng, n_nodes, n_waves=2):
+    """Random integer-valued fault schedule; dyadic slowdowns keep f32
+    stretched time exact (the parity-test regime)."""
+    fs = F.no_faults(n_nodes, n_waves)
+    for n in range(n_nodes):
+        if rng.random() < 0.6:
+            t = 0
+            for w in range(int(rng.integers(1, n_waves + 1))):
+                t += int(rng.integers(1, 120))
+                d = int(rng.integers(1, 60))
+                fs.down_start[n, w] = t
+                fs.down_end[n, w] = t + d
+                t += d
+        if rng.random() < 0.5:
+            fs.slowdown[n] = float(rng.choice([2.0, 4.0]))
+    return F.validate_fault_schedule(n_nodes, fs)
+
+
+def device_faults(fs):
+    return jax.tree.map(jnp.asarray, fs)
+
+
+class TestFaultScheduleBasics:
+    def test_node_up_half_open_interval(self):
+        fs = F.fault_schedule_from_events(2, [1], [5.0], [10.0])
+        fsd = device_faults(fs)
+        for t, want in [(0.0, [1, 1]), (5.0, [1, 0]), (14.9, [1, 0]),
+                        (15.0, [1, 1])]:
+            np.testing.assert_array_equal(
+                np.asarray(F.node_up(fsd, jnp.float32(t))), want)
+
+    def test_next_transition_strictly_after(self):
+        fs = device_faults(F.fault_schedule_from_events(2, [1], [5.0],
+                                                        [10.0]))
+        assert float(F.next_transition(fs, jnp.float32(0.0))) == 5.0
+        assert float(F.next_transition(fs, jnp.float32(5.0))) == 15.0
+        assert float(F.next_transition(fs, jnp.float32(15.0))) == np.inf
+
+    def test_job_stretch_gang_runs_at_slowest_node(self):
+        fs = device_faults(F.FaultSchedule(
+            *F.no_faults(3, 1)._replace(
+                slowdown=np.array([1.0, 2.0, 4.0], np.float32))))
+        alloc = jnp.asarray([[1, 1, 0], [0, 0, 2], [0, 0, 0]], jnp.int32)
+        np.testing.assert_allclose(np.asarray(F.job_stretch(fs, alloc)),
+                                   [2.0, 4.0, 1.0])
+
+    def test_straggler_stretches_completion(self):
+        trace = to_array_trace([JobRecord(0, 0.0, 10.0, 1)], max_jobs=2)
+        params = C.SimParams(1, 1, max_jobs=2, queue_len=2, n_placements=1)
+        tr = C.Trace.from_array_trace(trace)
+        fs = device_faults(F.FaultSchedule(
+            *F.no_faults(1, 1)._replace(
+                slowdown=np.array([2.0], np.float32))))
+        state = C.init_state(params, tr)
+        state, info = C.rl_step(params, state, tr, jnp.int32(0), fs)
+        assert bool(info.placed)
+        state, info = C.rl_step(params, state, tr,
+                                jnp.int32(params.n_actions - 1), fs)
+        # 10s of work at half speed: completes at t=20, not t=10
+        assert float(state.clock) == 20.0 and bool(info.done)
+
+    def test_drain_kills_to_pending_and_node_return_recovers(self):
+        trace = to_array_trace([JobRecord(0, 0.0, 10.0, 2)], max_jobs=2)
+        params = C.SimParams(1, 2, max_jobs=2, queue_len=2, n_placements=1)
+        tr = C.Trace.from_array_trace(trace)
+        fs = device_faults(F.fault_schedule_from_events(1, [0], [4.0],
+                                                        [6.0]))
+        noop = jnp.int32(params.n_actions - 1)
+        state = C.init_state(params, tr)
+        state, _ = C.rl_step(params, state, tr, jnp.int32(0), fs)  # place
+        state, info = C.rl_step(params, state, tr, noop, fs)  # -> drain@4
+        s = C.np_state(state)
+        assert float(s.clock) == 4.0 and s.status[0] == O.PENDING
+        # service preserved: 4 of 10 seconds done, GPUs back to free
+        assert s.remaining[0] == 6.0 and s.free.sum() == 2
+        # while down: placement masked AND try_place refuses
+        mask = np.asarray(C.action_mask(params, state, tr, faults=fs))
+        assert not mask[0] and mask[-1]
+        _, ok = C.try_place(params, state, tr, jnp.int32(0), jnp.int32(0),
+                            fs)
+        assert not bool(ok)
+        state, info = C.rl_step(params, state, tr, noop, fs)  # -> return@10
+        assert float(state.clock) == 10.0
+        mask = np.asarray(C.action_mask(params, state, tr, faults=fs))
+        assert mask[0]
+        state, info = C.rl_step(params, state, tr, jnp.int32(0), fs)
+        assert bool(info.placed) and not bool(info.first_placed)
+        state, info = C.rl_step(params, state, tr, noop, fs)
+        assert bool(info.done) and float(state.clock) == 16.0
+
+    def test_forced_place_fails_under_permanent_drain(self):
+        # both nodes' capacity halved forever; the 2-GPU job can never
+        # fit: forced-place must NOT fire (and must not lie)
+        trace = to_array_trace([JobRecord(0, 0.0, 5.0, 2)], max_jobs=2)
+        params = C.SimParams(2, 1, max_jobs=2, queue_len=2, n_placements=1)
+        tr = C.Trace.from_array_trace(trace)
+        fs = device_faults(F.FaultSchedule(
+            down_start=np.array([[0.0], [np.inf]], np.float32),
+            down_end=np.array([[np.inf], [np.inf]], np.float32),
+            slowdown=np.ones(2, np.float32)))
+        state = C.init_state(params, tr)
+        noop = jnp.int32(params.n_actions - 1)
+        state, info = C.rl_step(params, state, tr, noop, fs)
+        assert not bool(info.placed) and not bool(info.done)
+        assert float(info.dt) == 0.0
+
+
+class TestValidation:
+    def test_event_list_node_id_out_of_range(self):
+        with pytest.raises(ValueError, match="out of range"):
+            F.fault_schedule_from_events(2, [2], [1.0], [1.0])
+
+    def test_event_list_nonpositive_duration(self):
+        with pytest.raises(ValueError, match="durations must be positive"):
+            F.fault_schedule_from_events(2, [0], [1.0], [0.0])
+
+    def test_event_list_negative_start(self):
+        with pytest.raises(ValueError, match="must be >= 0"):
+            F.fault_schedule_from_events(2, [0], [-1.0], [1.0])
+
+    def test_unsorted_windows_rejected(self):
+        fs = F.no_faults(1, 2)
+        fs.down_start[0] = [10.0, 5.0]
+        fs.down_end[0] = [12.0, 7.0]
+        with pytest.raises(ValueError, match="sorted"):
+            F.validate_fault_schedule(1, fs)
+
+    def test_end_before_start_rejected(self):
+        fs = F.no_faults(1, 1)
+        fs.down_start[0, 0], fs.down_end[0, 0] = 5.0, 5.0
+        with pytest.raises(ValueError, match="positive"):
+            F.validate_fault_schedule(1, fs)
+
+    def test_slowdown_below_one_rejected(self):
+        fs = F.no_faults(1, 1)
+        fs.slowdown[0] = 0.5
+        with pytest.raises(ValueError, match="slowdown"):
+            F.validate_fault_schedule(1, fs)
+
+    def test_node_count_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="cluster has 3"):
+            F.validate_fault_schedule(3, F.no_faults(2, 1))
+
+    def test_validate_trace_delegates_fault_validation(self):
+        params = C.SimParams(2, 2, max_jobs=2, queue_len=2)
+        trace = to_array_trace([JobRecord(0, 0.0, 5.0, 1)], max_jobs=2)
+        C.validate_trace(params, trace, faults=F.no_faults(2, 1))  # ok
+        with pytest.raises(ValueError, match="cluster has 2"):
+            C.validate_trace(params, trace, faults=F.no_faults(3, 1))
+
+    def test_sampled_regimes_validate_and_seed_deterministically(self):
+        for name in F.FAULT_REGIMES:
+            a = F.sample_fault_schedule(4, name, (7, 0), 1000.0)
+            b = F.sample_fault_schedule(4, name, (7, 0), 1000.0)
+            for xa, xb in zip(a, b):
+                np.testing.assert_array_equal(xa, xb)
+        stats = F.schedule_stats(
+            F.sample_fault_schedule(64, "storm", 0, 1000.0))
+        assert stats["n_drains"] > 0 and stats["n_permanent"] == 0
+
+    def test_unknown_regime_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault regime"):
+            F.sample_fault_schedule(2, "meteor", 0, 100.0)
+
+
+def run_pair_faulty(trace, fs, n_nodes, gpus_per_node, actions, queue_len,
+                    n_placements=2, preempt_len=0):
+    """Drive oracle and JAX sim with the same actions AND the same fault
+    schedule; compare full trajectories after every step."""
+    params = C.SimParams(n_nodes=n_nodes, gpus_per_node=gpus_per_node,
+                         max_jobs=trace.max_jobs, queue_len=queue_len,
+                         n_placements=n_placements, preempt_len=preempt_len)
+    osim = O.OracleSim(trace, n_nodes, gpus_per_node, faults=fs)
+    tr = C.Trace.from_array_trace(trace)
+    fsd = device_faults(fs)
+    jstate = C.init_state(params, tr)
+    step = jax.jit(lambda s, f, a: C.rl_step(params, s, tr, a, f))
+    for i, a in enumerate(actions):
+        oinfo = osim.rl_step(int(a), queue_len, n_placements, preempt_len)
+        jstate, jinfo = step(jstate, fsd, jnp.int32(a))
+        s = C.np_state(jstate)
+        ctx = f"step {i} action {a}"
+        np.testing.assert_allclose(s.clock, osim.clock, atol=1e-3,
+                                   err_msg=ctx)
+        np.testing.assert_array_equal(s.status, osim.status, err_msg=ctx)
+        np.testing.assert_allclose(s.remaining, osim.remaining, atol=1e-3,
+                                   err_msg=ctx)
+        np.testing.assert_array_equal(s.alloc, osim.alloc, err_msg=ctx)
+        np.testing.assert_array_equal(s.free, osim.free, err_msg=ctx)
+        assert bool(jinfo.placed) == oinfo["placed"], ctx
+        assert bool(jinfo.preempted) == oinfo["preempted"], ctx
+        assert bool(jinfo.first_placed) == oinfo["first_placed"], ctx
+        np.testing.assert_allclose(float(jinfo.dt), oinfo["dt"], atol=1e-3,
+                                   err_msg=ctx)
+        assert bool(jinfo.done) == oinfo["done"], ctx
+        if oinfo["done"]:
+            break
+    return osim, jstate, params
+
+
+class TestOracleParityUnderFaults:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_actions_match_oracle(self, seed):
+        rng = np.random.default_rng(200 + seed)
+        trace = int_trace(rng, 20, 4, max_jobs=24)
+        fs = int_faults(rng, 3)
+        actions = rng.integers(0, 4 * 2 + 1, size=400)
+        run_pair_faulty(trace, fs, 3, 2, actions, queue_len=4)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_actions_with_preemption_match_oracle(self, seed):
+        rng = np.random.default_rng(300 + seed)
+        trace = int_trace(rng, 20, 4, max_jobs=24)
+        fs = int_faults(rng, 3)
+        n_actions = 4 * 2 + 3 + 1
+        actions = rng.integers(0, n_actions, size=500)
+        run_pair_faulty(trace, fs, 3, 2, actions, queue_len=4,
+                        preempt_len=3)
+
+
+def assert_invariants(s, trace, params, ctx):
+    """The conservation contract (ISSUE 6 satellite): GPUs and jobs are
+    conserved at every step, faulty or not."""
+    gpus = np.asarray(trace.gpus)
+    valid = np.asarray(trace.valid)
+    used = s.alloc.sum(axis=0)
+    np.testing.assert_array_equal(used + s.free,
+                                  np.full(params.n_nodes,
+                                          params.gpus_per_node), ctx)
+    assert (s.free >= 0).all() and (s.alloc >= 0).all(), ctx
+    running = s.status == O.RUNNING
+    alloc_j = s.alloc.sum(axis=1)
+    np.testing.assert_array_equal(alloc_j[running], gpus[running], ctx)
+    assert (alloc_j[~running] == 0).all(), ctx
+    live = np.isin(s.status, (O.NOT_ARRIVED, O.PENDING, O.RUNNING, O.DONE))
+    assert live[valid].all(), ctx          # no job ever lost
+    assert (s.remaining >= -1e-5).all(), ctx
+
+
+class TestConservationInvariants:
+    @pytest.mark.parametrize("seed,faulty,preempt_len", [
+        (0, False, 0), (1, False, 2), (2, True, 0), (3, True, 2),
+        (4, True, 3), (5, True, 0),
+    ])
+    def test_random_walk_conserves_gpus_and_jobs(self, seed, faulty,
+                                                 preempt_len):
+        rng = np.random.default_rng(400 + seed)
+        trace = int_trace(rng, 16, 4, max_jobs=20)
+        fs = int_faults(rng, 3) if faulty else None
+        params = C.SimParams(3, 2, max_jobs=20, queue_len=4,
+                             n_placements=2, preempt_len=preempt_len)
+        tr = C.Trace.from_array_trace(trace)
+        fsd = device_faults(fs) if fs is not None else None
+        jstate = C.init_state(params, tr)
+        step = jax.jit(lambda s, a: C.rl_step(params, s, tr, a, fsd))
+        for i, a in enumerate(rng.integers(0, params.n_actions, size=300)):
+            jstate, info = step(jstate, jnp.int32(a))
+            assert_invariants(C.np_state(jstate), trace, params,
+                              f"seed {seed} step {i}")
+            if bool(info.done):
+                break
+
+    def test_drained_node_never_hosts_a_running_job(self):
+        rng = np.random.default_rng(11)
+        trace = int_trace(rng, 12, 4, max_jobs=16)
+        fs = int_faults(rng, 3)
+        params = C.SimParams(3, 2, max_jobs=16, queue_len=4,
+                             n_placements=1)
+        tr = C.Trace.from_array_trace(trace)
+        fsd = device_faults(fs)
+        jstate = C.init_state(params, tr)
+        step = jax.jit(lambda s, a: C.rl_step(params, s, tr, a, fsd))
+        for a in rng.integers(0, params.n_actions, size=250):
+            jstate, info = step(jstate, jnp.int32(a))
+            s = C.np_state(jstate)
+            up = np.asarray(F.node_up(fsd, jnp.float32(s.clock)))
+            assert (s.alloc[:, ~up] == 0).all(), float(s.clock)
+            if bool(info.done):
+                break
+
+
+class TestCompileOnceAcrossSchedules:
+    def test_two_schedules_one_trace_zero_retrace(self):
+        """Fault schedules are DATA: a jitted step warmed up under one
+        schedule must neither trace nor compile under a different one of
+        the same shape (the ISSUE 6 acceptance gate)."""
+        from rlgpuschedule_tpu.analysis.sentinels import CompileCounter
+        rng = np.random.default_rng(0)
+        trace = int_trace(rng, 10, 4, max_jobs=12)
+        params = C.SimParams(3, 2, max_jobs=12, queue_len=4,
+                             n_placements=1, preempt_len=2)
+        tr = C.Trace.from_array_trace(trace)
+        fs_a = device_faults(int_faults(np.random.default_rng(1), 3))
+        fs_b = device_faults(int_faults(np.random.default_rng(2), 3))
+        step = jax.jit(lambda s, f, a: C.rl_step(params, s, tr, a, f))
+        state = C.init_state(params, tr)
+        state, _ = step(state, fs_a, jnp.int32(0))          # warmup
+        jax.block_until_ready(state.clock)
+        state2 = C.init_state(params, tr)
+        actions = [jnp.int32(int(a)) for a in
+                   rng.integers(0, params.n_actions, size=8)]
+        with CompileCounter() as counter:
+            for a in actions:
+                state2, _ = step(state2, fs_b, a)
+            jax.block_until_ready(state2.clock)
+        assert counter.total == 0, counter.events
+
+
+class TestEnvAndTrainingWiring:
+    def _cfg(self, **kw):
+        from rlgpuschedule_tpu.configs import CONFIGS
+        base = dict(n_envs=2, n_nodes=2, gpus_per_node=4, window_jobs=16,
+                    queue_len=4, horizon=64, iterations=2, faults="storm")
+        return dataclasses.replace(CONFIGS["ppo-mlp-synth64"],
+                                   **{**base, **kw})
+
+    def test_fault_obs_shape_and_health_values(self):
+        from rlgpuschedule_tpu.env import env as env_lib
+        params = C.SimParams(2, 2, max_jobs=4, queue_len=2, n_placements=1)
+        ep = env_lib.EnvParams(sim=params, fault_process=F.FAULT_REGIMES
+                               ["sporadic"], fault_obs=True)
+        base = env_lib.EnvParams(sim=params)
+        assert ep.obs_shape()[0] == base.obs_shape()[0] + 2
+        trace = to_array_trace([JobRecord(0, 0.0, 5.0, 1)], max_jobs=4)
+        tr = C.Trace.from_array_trace(trace)
+        fs = device_faults(F.fault_schedule_from_events(
+            2, [1], [0.0], [10.0], slowdown=[2.0, 1.0]))
+        state, ts = env_lib.reset(ep, tr, fs)
+        # node 0: straggler at half speed; node 1: drained -> 0
+        np.testing.assert_allclose(np.asarray(ts.obs[-2:]), [0.5, 0.0])
+        # faults=None replay of a fault-trained policy: all-healthy
+        state, ts = env_lib.reset(ep, tr)
+        np.testing.assert_allclose(np.asarray(ts.obs[-2:]), [1.0, 1.0])
+
+    def test_fault_obs_refused_for_grid(self):
+        from rlgpuschedule_tpu.env import env as env_lib
+        params = C.SimParams(2, 2, max_jobs=4, queue_len=2)
+        with pytest.raises(ValueError, match="FLAT"):
+            env_lib.EnvParams(sim=params, obs_kind="grid", fault_obs=True)
+
+    def test_vec_env_auto_resets_under_faults(self):
+        from rlgpuschedule_tpu.env import env as env_lib
+        rng = np.random.default_rng(3)
+        params = C.SimParams(2, 2, max_jobs=8, queue_len=4, n_placements=1)
+        ep = env_lib.EnvParams(sim=params, horizon=16)
+        traces = env_lib.stack_traces(
+            [int_trace(np.random.default_rng(s), 6, 3, max_jobs=8)
+             for s in range(2)], ep)
+        faults = F.stack_fault_schedules(
+            [int_faults(np.random.default_rng(10 + s), 2)
+             for s in range(2)])
+        state, ts = env_lib.vec_reset(ep, traces, faults)
+        fresh = (state, ts)
+        saw_done = False
+        for i in range(40):
+            acts = jnp.asarray(rng.integers(0, params.n_actions, size=2),
+                               jnp.int32)
+            state, ts = env_lib.vec_step(ep, state, traces, acts, fresh,
+                                         faults)
+            saw_done = saw_done or bool(ts.done.any())
+            assert np.isfinite(np.asarray(ts.obs)).all()
+        assert saw_done   # horizon 16 over 40 steps must auto-reset
+
+    def test_experiment_trains_under_fault_regime(self):
+        from rlgpuschedule_tpu.experiment import Experiment
+        exp = Experiment.build(self._cfg())
+        assert exp.faults is not None
+        assert exp.env_params.fault_obs
+        out = exp.run(log_every=1)
+        assert np.isfinite(out["history"][-1]["total_loss"])
+
+    def test_population_refuses_faults(self):
+        from rlgpuschedule_tpu.experiment import PopulationExperiment
+        with pytest.raises(ValueError, match="fault"):
+            PopulationExperiment.build(self._cfg(), n_pop=2)
+
+    def test_hier_refuses_faults(self):
+        from rlgpuschedule_tpu.experiment import Experiment
+        with pytest.raises(ValueError, match="fault"):
+            Experiment.build(self._cfg(n_pods=2, n_nodes=4))
+
+
+class TestChaosReport:
+    def test_matrix_degradation_and_conservation(self, tmp_path):
+        from rlgpuschedule_tpu.eval import chaos_report
+        from rlgpuschedule_tpu.experiment import Experiment
+        from rlgpuschedule_tpu.configs import CONFIGS
+        from rlgpuschedule_tpu.obs import EventBus, Registry, read_events
+        cfg = dataclasses.replace(
+            CONFIGS["ppo-mlp-synth64"], n_envs=2, n_nodes=2,
+            gpus_per_node=4, window_jobs=16, queue_len=4, horizon=256)
+        exp = Experiment.build(cfg)
+        bus = EventBus(str(tmp_path), rank=0, name="chaos")
+        registry = Registry()
+        report = chaos_report(exp, regimes=("sporadic",),
+                              baselines=("sjf",), seed=0, bus=bus,
+                              registry=registry)
+        bus.close()
+        # clean control always present; every cell carries the triple
+        assert set(report["regimes"]) == {"none", "sporadic"}
+        for rows in report["regimes"].values():
+            assert set(rows) == {"policy", "sjf"}
+            for row in rows.values():
+                assert {"avg_jct", "completion", "degradation"} <= set(row)
+        assert report["regimes"]["none"]["policy"]["degradation"] == 1.0
+        assert report["jobs_lost"] == 0
+        assert report["fault_stats"]["sporadic"]["n_drains"] >= 0
+        events = read_events(str(tmp_path / "events.chaos.jsonl"))
+        cells = [e for e in events if e["kind"] == "env_fault"]
+        assert len(cells) == 4    # 2 regimes x (policy + sjf)
+        assert {(e["regime"], e["scheduler"]) for e in cells} == {
+            ("none", "policy"), ("none", "sjf"),
+            ("sporadic", "policy"), ("sporadic", "sjf")}
+        assert "chaos_none_policy_avg_jct" in registry.render()
+
+    def test_baselines_degrade_under_pure_drains(self):
+        # drains can only delay work (service is preserved, capacity
+        # temporarily shrinks): oracle SJF's avg JCT under a real drain
+        # schedule must be >= its clean JCT on the same trace
+        rng = np.random.default_rng(9)
+        trace = int_trace(rng, 15, 4, max_jobs=16)
+        fs = F.fault_schedule_from_events(
+            3, [0, 1], [20.0, 30.0], [200.0, 150.0])
+        faulty = run_baseline(trace, 3, 2, "sjf", faults=fs)
+        clean = run_baseline(trace, 3, 2, "sjf", backend="python")
+        assert faulty.avg_jct() >= clean.avg_jct()
+        assert faulty.done() and faulty.gpus_consistent()
