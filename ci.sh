@@ -53,7 +53,8 @@ echo "=== smoke: observability (3-iter CPU run + merged-timeline report) ==="
 # in one exit code (ISSUE 5 acceptance).
 OBS_DIR=$(mktemp -d /tmp/ci_obs.XXXXXX)
 CHAOS_JSON=$(mktemp /tmp/ci_chaos.XXXXXX.json)
-trap 'rm -rf "$OBS_DIR" "$CHAOS_JSON"' EXIT
+SERVE_JSON=$(mktemp /tmp/ci_serve.XXXXXX.json)
+trap 'rm -rf "$OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON"' EXIT
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python -m rlgpuschedule_tpu.train --config ppo-mlp-synth64 \
     --iterations 3 --n-envs 4 --n-nodes 2 --gpus-per-node 4 \
@@ -83,6 +84,38 @@ for regime, rows in rep["regimes"].items():
 assert rep["repro"]["chaos_seed"] == 0
 print("chaos smoke ok:", {r: round(rows["policy"]["degradation"], 3)
                           for r, rows in rep["regimes"].items()})
+EOF
+
+echo "=== smoke: serving (bench + fleet replay, CPU) ==="
+# ISSUE 7 acceptance: a short serve --bench must report p50/p99 decision
+# latency and nonzero decisions/s with ZERO post-warmup recompiles
+# across >= 3 distinct request sizes in one bucket, the fleet replay
+# must complete, and the live scrape endpoint must answer with a
+# well-formed Prometheus exposition (the CLI self-scrapes and records
+# the verdict in its JSON).
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.serve --config ppo-mlp-synth64 \
+    --bench --fleet 2 --bucket 8 --rounds 9 --pool-steps 2 \
+    --n-envs 2 --n-nodes 2 --gpus-per-node 4 --window-jobs 16 \
+    --queue-len 4 --horizon 64 --max-steps 96 \
+    --metrics-port 0 > "$SERVE_JSON"
+python - "$SERVE_JSON" <<'EOF'
+import json, sys
+rep = json.load(open(sys.argv[1]))
+b = rep["bench"]
+assert b["post_warmup_recompiles"] == 0, b
+assert b["decisions_per_s"] > 0 and b["latency_p50_ms"] > 0, b
+assert len(set(b["request_sizes"])) >= 3 and b["buckets"] == [8], b
+fl = rep["fleet"]
+assert fl["n_clusters"] == 2 and fl["decisions"] > 0, fl
+assert fl["completion"] > 0, fl
+sc = rep["scrape"]
+assert sc["well_formed"] and sc["status"] == 200, sc
+assert sc["metric_lines"] > 0, sc
+assert rep["repro"]["config"] == "ppo-mlp-synth64"
+print("serve smoke ok:", {"p50_ms": round(b["latency_p50_ms"], 3),
+                          "decisions_per_s": round(b["decisions_per_s"]),
+                          "fleet_mean_jct": round(fl["mean_jct"], 1)})
 EOF
 
 echo "=== tier-1 pytest gate 1/2: main pass (ROADMAP.md, minus spawn) ==="

@@ -144,3 +144,25 @@ HIER_PBT_MEMBER = _register(ExperimentConfig(
     name="hier-pbt-member", algo="ppo", n_nodes=16, gpus_per_node=8,
     n_pods=4, trace="synthetic", n_envs=4, obs_kind="flat",
     window_jobs=64))
+
+
+def repro_tuple(cfg: ExperimentConfig, ckpt_dir: str | None = None,
+                ckpt_step: int | None = None) -> dict:
+    """The reproducibility tuple every evaluate/serve JSON carries: the
+    resolved config fields that determine a replay plus the checkpoint
+    provenance — enough to regenerate any reported row exactly. ONE
+    definition shared by ``evaluate`` and ``serve`` so serving numbers
+    are reproducible the same way evaluation numbers are (PR 7).
+
+    ``ckpt_step`` must be the RESOLVED restored step
+    (``Checkpointer.last_restored_step``), not the requested one: the
+    integrity fallback may restore an older retained step than asked
+    for, and the tuple exists to name what actually ran."""
+    return {"config": cfg.name, "seed": cfg.seed, "trace": cfg.trace,
+            "trace_path": cfg.trace_path, "trace_load": cfg.trace_load,
+            "source_jobs": cfg.source_jobs, "n_envs": cfg.n_envs,
+            "n_nodes": cfg.n_nodes, "gpus_per_node": cfg.gpus_per_node,
+            "window_jobs": cfg.window_jobs, "queue_len": cfg.queue_len,
+            "horizon": cfg.horizon, "obs_kind": cfg.obs_kind,
+            "drain_frac": cfg.drain_frac, "faults": cfg.faults,
+            "ckpt_dir": ckpt_dir, "ckpt_step": ckpt_step}

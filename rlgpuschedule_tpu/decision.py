@@ -1,0 +1,77 @@
+"""Shared greedy/masked policy-decision logic (eval ↔ serve).
+
+The deterministic decision rule — greedy argmax over masked logits, with
+the zero-dt stall gate that masks preempt actions past the legitimate
+same-instant activity bound — used to live inline in ``eval.replay``.
+The serving path (``serve/``) dispatches the SAME rule per request
+batch, so the logic is extracted here and consumed by both: a change to
+how actions are selected lands in evaluation and serving together, and
+the two cannot drift (tests/test_serve.py pins bit-identity).
+
+Everything here is jit-pure and shape-polymorphic over the leading
+batch axis: ``mask``/``obs`` may be a single request (``[A]``) or a
+batch (``[E, A]``), and pytree observation/logit structures (the
+hierarchical env) pass through ``jax.tree.map`` untouched.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def preempt_slice(env_params) -> jax.Array | None:
+    """bool[n_actions] marking the preempt actions, or None if the flat
+    action space has none (the stall gate is then a no-op)."""
+    from .env.hier import HierParams
+    if isinstance(env_params, HierParams) or not env_params.sim.preempt_len:
+        return None
+    sim = env_params.sim
+    kp = sim.queue_len * sim.n_placements
+    pre = np.zeros(sim.n_actions, bool)
+    pre[kp:kp + sim.preempt_len] = True
+    return jnp.asarray(pre)
+
+
+def stall_threshold(env_params) -> int:
+    """Upper bound on LEGITIMATE consecutive zero-dt decision steps.
+
+    At one sim instant a policy can place at most ``queue_len`` distinct
+    pending jobs (a placed job leaves the queue) and rearrange at most
+    ``preempt_len`` running ones; anything beyond that bound within a
+    single clock instant is revisiting — i.e. a place↔preempt cycle. The
+    +4 keeps the bound safely above any interleaving slack."""
+    sim = env_params.sim
+    return sim.queue_len + sim.preempt_len + 4
+
+
+def gate_stalled(mask: jax.Array, stall: jax.Array, thresh: int,
+                 pre: jax.Array) -> jax.Array:
+    """Mask the preempt actions of every request at/past the stall
+    threshold (the eval-time place↔preempt cycle breaker — see
+    ``eval.replay``'s docstring for the measured deadlock it fixes).
+
+    ``stall`` is ``i32[]`` or ``i32[E]`` consecutive-zero-dt counts;
+    ``mask`` is ``bool[A]`` or ``bool[E, A]`` — the explicit broadcast
+    handles both the batched and the single-request form identically
+    (and stays legal under ``jax_numpy_rank_promotion="raise"``)."""
+    blocked = (jnp.expand_dims(stall >= thresh, -1)
+               & jnp.broadcast_to(pre, mask.shape))
+    return mask & ~blocked
+
+
+def greedy_actions(logits: Any) -> Any:
+    """Argmax per action head (pytree logits for the hierarchical env)."""
+    return jax.tree.map(lambda lg: jnp.argmax(lg, axis=-1), logits)
+
+
+def policy_decision(apply_fn: Callable, net_params: Any, obs: Any,
+                    mask: Any) -> Any:
+    """THE deterministic decision: masked logits -> greedy actions.
+
+    One call site shape shared by ``eval.replay`` (per scan step) and
+    ``serve.InferenceEngine`` (per request-batch dispatch)."""
+    logits, _ = apply_fn(net_params, obs, mask)
+    return greedy_actions(logits)

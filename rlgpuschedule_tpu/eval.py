@@ -20,6 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .algos import action_dist
+from .decision import (gate_stalled, greedy_actions, policy_decision,
+                       preempt_slice, stall_threshold)
 from .env import env as env_lib
 from .env import hier as hier_lib
 from .env.env import EnvParams
@@ -43,32 +45,13 @@ class EvalResult(NamedTuple):
     steps: jax.Array        # i32[E] decision steps taken
 
 
-def _greedy_actions(logits: Any) -> Any:
-    return jax.tree.map(lambda lg: jnp.argmax(lg, axis=-1), logits)
-
-
-def _preempt_slice(env_params: EnvParams) -> jax.Array | None:
-    """bool[n_actions] marking the preempt actions, or None if the flat
-    action space has none (guard is then a no-op)."""
-    if isinstance(env_params, HierParams) or not env_params.sim.preempt_len:
-        return None
-    sim = env_params.sim
-    kp = sim.queue_len * sim.n_placements
-    pre = np.zeros(sim.n_actions, bool)
-    pre[kp:kp + sim.preempt_len] = True
-    return jnp.asarray(pre)
-
-
-def _stall_threshold(env_params: EnvParams) -> int:
-    """Upper bound on LEGITIMATE consecutive zero-dt decision steps.
-
-    At one sim instant a policy can place at most ``queue_len`` distinct
-    pending jobs (a placed job leaves the queue) and rearrange at most
-    ``preempt_len`` running ones; anything beyond that bound within a
-    single clock instant is revisiting — i.e. a place↔preempt cycle. The
-    +4 keeps the bound safely above any interleaving slack."""
-    sim = env_params.sim
-    return sim.queue_len + sim.preempt_len + 4
+# the decision rule (greedy argmax over masked logits + the stall gate)
+# is shared with the serving path — rlgpuschedule_tpu.decision is the one
+# definition both consume, so serve and eval cannot drift (PR 7). These
+# module-private names stay as aliases for in-repo callers.
+_greedy_actions = greedy_actions
+_preempt_slice = preempt_slice
+_stall_threshold = stall_threshold
 
 
 def _random_actions(key: jax.Array, mask: Any) -> Any:
@@ -216,12 +199,11 @@ def replay(apply_fn: Callable, net_params: Any,
     def scan_step(carry, k):
         state, obs, mask, done, busy_time, stall = carry
         if pre is not None:
-            mask = mask & ~((stall >= thresh)[:, None] & pre[None, :])
+            mask = gate_stalled(mask, stall, thresh, pre)
         if policy == "random":
             actions = _random_actions(k, mask)
         else:
-            logits, _ = apply_fn(net_params, obs, mask)
-            actions = _greedy_actions(logits)
+            actions = policy_decision(apply_fn, net_params, obs, mask)
         if backlog_gate:
             actions = _gate_to_fifo(env_params, state.sim.status, mask,
                                     actions, backlog_gate)
@@ -357,15 +339,14 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
             state, obs, mask, frozen, stall = carry
             if pre is not None:
                 # same zero-dt cycle breaker as replay(): see its docstring
-                mask = mask & ~((stall >= thresh) & pre)
+                mask = gate_stalled(mask, stall, thresh, pre)
             if policy == "random":
                 # masked-uniform; _random_actions expects a batch axis
                 action = jax.tree.map(
                     lambda a: a[0],
                     _random_actions(k, jax.tree.map(lambda m: m[None], mask)))
             else:
-                logits, _ = apply_fn(net_params, obs, mask)
-                action = _greedy_actions(logits)
+                action = policy_decision(apply_fn, net_params, obs, mask)
             if backlog_gate:
                 action = _gate_to_fifo(rp, state.sim.status, mask,
                                        action, backlog_gate)

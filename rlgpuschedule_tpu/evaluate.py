@@ -235,15 +235,11 @@ def main(argv: list[str] | None = None) -> dict:
 
     # the full reproducibility tuple every evaluate JSON carries: enough
     # to regenerate any row (chaos-matrix rows included) exactly —
-    # resolved checkpoint step filled in by restore() below
-    repro = {"config": cfg.name, "seed": cfg.seed, "trace": cfg.trace,
-             "trace_path": cfg.trace_path, "trace_load": cfg.trace_load,
-             "source_jobs": cfg.source_jobs, "n_envs": cfg.n_envs,
-             "n_nodes": cfg.n_nodes, "gpus_per_node": cfg.gpus_per_node,
-             "window_jobs": cfg.window_jobs, "queue_len": cfg.queue_len,
-             "horizon": cfg.horizon, "obs_kind": cfg.obs_kind,
-             "drain_frac": cfg.drain_frac, "faults": cfg.faults,
-             "ckpt_dir": args.ckpt_dir, "ckpt_step": None}
+    # resolved checkpoint step filled in by restore() below. The tuple's
+    # shape is shared with the serve CLI (configs.repro_tuple), so
+    # serving numbers reproduce the same way evaluation numbers do
+    from .configs import repro_tuple
+    repro = repro_tuple(cfg, ckpt_dir=args.ckpt_dir)
 
     if args.percentiles and (args.fairness or args.baselines_only
                              or args.pbt):

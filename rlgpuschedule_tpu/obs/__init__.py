@@ -13,7 +13,9 @@ to *when and on which rank*.
   :func:`merge_dir` orders interleaved per-rank streams into one
   timeline by the shared monotonic clock.
 - :mod:`.metrics` — counters/gauges registry with an atomic
-  Prometheus-text snapshot file (``metrics.prom``).
+  Prometheus-text snapshot file (``metrics.prom``) and a live stdlib
+  HTTP scrape endpoint (:func:`serve_http` — what the serving CLI's
+  ``--metrics-port`` exposes).
 - :mod:`.telemetry` — :class:`RunTelemetry` (what ``Experiment.run`` /
   ``PopulationExperiment.run`` hold: iteration spans with a
   rollout+update/sync/eval/ckpt phase breakdown, zero added host syncs)
@@ -40,12 +42,13 @@ Event kinds by emitter:
 """
 from .events import (EventBus, SCHEMA_VERSION, event_streams, merge_dir,
                      merge_events, read_events)
-from .metrics import Counter, Gauge, Registry
+from .metrics import (Counter, Gauge, MetricsHTTPServer, Registry,
+                      serve_http)
 from .telemetry import AlarmError, Alarms, RunTelemetry
 
 __all__ = [
     "EventBus", "SCHEMA_VERSION", "event_streams", "merge_dir",
     "merge_events", "read_events",
-    "Counter", "Gauge", "Registry",
+    "Counter", "Gauge", "MetricsHTTPServer", "Registry", "serve_http",
     "AlarmError", "Alarms", "RunTelemetry",
 ]

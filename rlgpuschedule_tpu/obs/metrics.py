@@ -3,10 +3,16 @@
 The event bus answers "what happened, when"; this registry answers "how
 much, right now" — monotonically increasing counters (iterations run,
 recompile alarms fired) and point-in-time gauges (steps/s). The snapshot
-is the Prometheus *text exposition format* written to a file, not an
-HTTP endpoint: training hosts usually can't open ports, but every fleet
-scraper (node-exporter textfile collector, a sidecar, plain ``cat``)
-can read a file, and the format is the observability lingua franca.
+is the Prometheus *text exposition format*, delivered two ways:
+
+- a file (``Registry.write``): training hosts usually can't open ports,
+  but every fleet scraper (node-exporter textfile collector, a sidecar,
+  plain ``cat``) can read a file;
+- an actual scrape endpoint (:func:`serve_http`, PR 7): a serving host
+  IS a network service already, so its SLO gauges are scraped live over
+  HTTP — a stdlib ``http.server`` thread rendering the same exposition,
+  no new dependency (closing the "snapshot to an actual scrape endpoint
+  rather than files" deployment residual).
 
 Dependency-free by the same argument as the hand-rolled TensorBoard
 writer in ``utils.logging``: the write cadence is one small file per
@@ -105,3 +111,79 @@ class Registry:
         with open(tmp, "w") as f:
             f.write(self.render())
         os.replace(tmp, path)
+
+
+# the Prometheus text exposition content type (format version 0.0.4 —
+# the plain-text lingua franca every scraper accepts)
+EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsHTTPServer:
+    """A live scrape endpoint for one :class:`Registry`: a daemon-thread
+    stdlib ``http.server`` answering ``GET /metrics`` (and ``/``) with
+    the registry's current text exposition.
+
+    Rendering happens per request under the GIL against the registry's
+    plain-float metric values, so a scrape observes a consistent-enough
+    point-in-time view without any locking on the hot serving path (the
+    same argument the atomic file snapshot makes, minus the file).
+
+    ``port=0`` binds an ephemeral port (tests, the ci.sh smoke stage);
+    the resolved port is ``self.port``. Always ``close()`` (or use as a
+    context manager) — the listener thread is daemonized but the socket
+    is a real bound resource.
+    """
+
+    def __init__(self, registry: Registry, port: int = 0,
+                 host: str = "127.0.0.1"):
+        import http.server
+        import threading
+
+        reg = registry
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 (http.server API)
+                if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+                    self.send_error(404, "scrape endpoint serves /metrics")
+                    return
+                body = reg.render().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", EXPOSITION_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass    # scrapes are periodic; stderr chatter helps nobody
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-scrape",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "MetricsHTTPServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def serve_http(registry: Registry, port: int = 0,
+               host: str = "127.0.0.1") -> MetricsHTTPServer:
+    """Start the live scrape endpoint for ``registry``; returns the
+    server (``.port`` holds the resolved port, ``.close()`` stops it)."""
+    return MetricsHTTPServer(registry, port=port, host=host)

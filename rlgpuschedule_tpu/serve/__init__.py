@@ -1,0 +1,36 @@
+"""Fleet-scale serving (L6): continuous batching + vmapped fleet replay.
+
+The ROADMAP's "millions of users" entry point (PR 7): the trained
+scheduler policy served as a batched inference system instead of a
+one-at-a-time evaluation.
+
+- :mod:`.engine` — :class:`InferenceEngine`: the stateless jit'd
+  ``policy_step(obs_batch) -> actions``, compiled once per power-of-two
+  batch bucket with donated request buffers, sharing the greedy/masked
+  decision rule with ``eval.replay`` (:mod:`..decision`) and policed by
+  the jsan runtime sentinels — post-warmup recompiles and implicit
+  host syncs are production alarms, not silent slowdowns.
+- :mod:`.batching` — the continuous-batching front end:
+  :class:`PolicyServer` request queue (coalesce to the next bucket,
+  pad, dispatch, scatter in FIFO order) + the SLO metric surface
+  (p50/p99 decision latency, decisions/s/chip, queue depth, batch
+  occupancy) through the ``obs`` registry.
+- :mod:`.fleet` — vmapped fleet replay: one checkpoint vs N seeded
+  simulated clusters (optionally under ``sim.faults`` regimes) in a
+  single fused-scan dispatch, bit-identical to N sequential
+  ``eval.replay`` runs.
+- :mod:`.bench` — the ``serve --bench`` driver: deterministic request
+  streams, zero-recompile steady-state assertion.
+- ``python -m rlgpuschedule_tpu.serve`` — the CLI (``--bench``,
+  ``--fleet N``, ``--metrics-port`` live Prometheus scrape endpoint).
+"""
+from .batching import (PolicyServer, ServeResult, next_bucket, pad_batch,
+                       scatter_results, stack_requests)
+from .engine import InferenceEngine
+from .fleet import fleet_replay, fleet_windows, sample_fleet_faults
+
+__all__ = [
+    "InferenceEngine", "PolicyServer", "ServeResult",
+    "next_bucket", "pad_batch", "scatter_results", "stack_requests",
+    "fleet_replay", "fleet_windows", "sample_fleet_faults",
+]

@@ -1,0 +1,282 @@
+"""Serving CLI: ``python -m rlgpuschedule_tpu.serve``.
+
+Two modes, composable in one invocation:
+
+- ``--bench``: drive a deterministic synthetic request stream through
+  the continuous-batching policy server and report the SLO table —
+  p50/p99 decision latency, decisions/s(/chip), batch occupancy, and
+  the steady-state contract (zero post-warmup recompiles across
+  distinct request sizes within one bucket, CompileCounter-verified).
+- ``--fleet N``: vmapped fleet replay — the checkpoint vs N seeded
+  simulated clusters in one dispatch (optionally under a
+  ``sim.faults`` regime), reporting fleet mean JCT / completion /
+  decisions/s.
+
+``--metrics-port`` exposes the live Prometheus scrape endpoint
+(``obs.serve_http``); ``--obs-dir`` writes the event stream (blessed
+``compile`` / alarm ``recompile`` events) + a ``metrics.prom``
+snapshot. The JSON on stdout carries the same reproducibility tuple
+``evaluate`` emits (``configs.repro_tuple``: config/seed/.../ckpt_dir/
+RESOLVED ckpt_step), so serving numbers are regenerable exactly.
+
+Examples::
+
+    python -m rlgpuschedule_tpu.serve --config ppo-mlp-synth64 \
+        --ckpt-dir out/ckpt --bench --bucket 16
+    python -m rlgpuschedule_tpu.serve --config ppo-mlp-synth64 \
+        --fleet 512 --fleet-regime storm --metrics-port 9090
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="rlgpuschedule_tpu.serve",
+        description="Fleet-scale policy serving: continuous-batching "
+                    "bench + vmapped fleet replay.")
+    p.add_argument("--config", default="ppo-mlp-synth64")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="restore the served policy from this checkpoint "
+                        "dir (omit = untrained init weights; pick the "
+                        "step with select_checkpoint)")
+    p.add_argument("--ckpt-step", type=int, default=None)
+    # cluster-shape overrides — MUST match the training run when
+    # restoring a checkpoint (same contract as evaluate)
+    p.add_argument("--trace", default=None,
+                   choices=["synthetic", "philly", "pai", "philly-proxy",
+                            "pai-proxy"])
+    p.add_argument("--trace-path", default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--n-envs", type=int, default=None)
+    p.add_argument("--n-nodes", type=int, default=None)
+    p.add_argument("--gpus-per-node", type=int, default=None)
+    p.add_argument("--window-jobs", type=int, default=None)
+    p.add_argument("--queue-len", type=int, default=None)
+    p.add_argument("--horizon", type=int, default=None)
+    p.add_argument("--obs-kind", default=None,
+                   choices=["flat", "grid", "graph"])
+    # bench mode
+    p.add_argument("--bench", action="store_true",
+                   help="latency bench: deterministic request stream "
+                        "through the continuous-batching server; "
+                        "asserts the zero-recompile steady state")
+    p.add_argument("--bucket", type=int, default=8,
+                   help="largest power-of-two batch bucket the engine "
+                        "compiles (bench default request sizes live in "
+                        "(bucket/2, bucket])")
+    p.add_argument("--rounds", type=int, default=24,
+                   help="bench: coalesced dispatches to serve")
+    p.add_argument("--request-sizes", default=None, metavar="A,B,...",
+                   help="bench: request counts to cycle per round "
+                        "(default: three distinct sizes inside the "
+                        "--bucket bucket)")
+    p.add_argument("--pool-steps", type=int, default=4,
+                   help="bench: env decision steps used to materialize "
+                        "the request pool")
+    # fleet mode
+    p.add_argument("--fleet", type=int, default=None, metavar="N",
+                   help="fleet replay: evaluate the checkpoint against "
+                        "N seeded simulated clusters in one dispatch")
+    p.add_argument("--fleet-regime", default=None, metavar="REGIME",
+                   help="with --fleet: replay every cluster under this "
+                        "seeded fault regime (sim.faults.FAULT_REGIMES; "
+                        "flat configs)")
+    p.add_argument("--fleet-seed", type=int, default=0,
+                   help="with --fleet-regime: base seed of the fault "
+                        "draws (cluster e draws (seed, e))")
+    p.add_argument("--max-steps", type=int, default=None,
+                   help="fleet: cap decision steps per cluster "
+                        "(default: the env horizon)")
+    # observability
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="expose the live Prometheus scrape endpoint on "
+                        "this port (0 = ephemeral; the bound port and a "
+                        "self-scrape check land in the JSON)")
+    p.add_argument("--obs-dir", default=None,
+                   help="emit serve events (JSONL bus) + a metrics.prom "
+                        "snapshot under this directory")
+    return p
+
+
+def main(argv: "list[str] | None" = None) -> dict:
+    args = build_parser().parse_args(argv)
+    from ..configs import CONFIGS, repro_tuple
+    if args.config not in CONFIGS:
+        sys.exit(f"unknown config {args.config!r}")
+    if not args.bench and args.fleet is None:
+        sys.exit("nothing to do: pass --bench and/or --fleet N")
+    if args.fleet is not None and args.fleet <= 0:
+        sys.exit("--fleet must be a positive cluster count")
+    if args.bucket <= 0 or (args.bucket & (args.bucket - 1)):
+        sys.exit("--bucket must be a positive power of two")
+    if args.fleet_regime is not None and args.fleet is None:
+        sys.exit("--fleet-regime configures --fleet replay; pass "
+                 "--fleet N with it (refusing the silent no-op)")
+    sizes = None
+    if args.request_sizes is not None:
+        if not args.bench:
+            sys.exit("--request-sizes configures --bench (refusing the "
+                     "silent no-op)")
+        try:
+            sizes = tuple(int(s) for s in args.request_sizes.split(",")
+                          if s)
+        except ValueError:
+            sys.exit(f"bad --request-sizes {args.request_sizes!r}")
+        if not sizes or any(s <= 0 for s in sizes):
+            sys.exit("--request-sizes must be positive integers")
+        too_big = [s for s in sizes if s > args.bucket]
+        if too_big:
+            sys.exit(f"--request-sizes {too_big} exceed --bucket "
+                     f"{args.bucket}")
+    if args.fleet_regime is not None:
+        from ..sim.faults import FAULT_REGIMES
+        if args.fleet_regime not in FAULT_REGIMES:
+            sys.exit(f"unknown --fleet-regime {args.fleet_regime!r}; "
+                     f"known: {sorted(FAULT_REGIMES)}")
+
+    cfg = CONFIGS[args.config]
+    over = {k: v for k, v in
+            {"trace": args.trace, "trace_path": args.trace_path,
+             "seed": args.seed, "n_envs": args.n_envs,
+             "n_nodes": args.n_nodes,
+             "gpus_per_node": args.gpus_per_node,
+             "window_jobs": args.window_jobs,
+             "queue_len": args.queue_len, "horizon": args.horizon,
+             "obs_kind": args.obs_kind}.items() if v is not None}
+    cfg = dataclasses.replace(cfg, **over)
+
+    import os
+
+    from ..experiment import Experiment
+    from ..obs import EventBus, Registry
+    from ..utils.platform import enable_compile_cache
+    from .batching import PolicyServer
+    from .bench import build_request_pool, run_bench
+    from .engine import InferenceEngine
+    from .fleet import fleet_replay, fleet_windows, sample_fleet_faults
+
+    enable_compile_cache()
+    repro = repro_tuple(cfg, ckpt_dir=args.ckpt_dir)
+
+    exp = Experiment.build(cfg)
+    if args.ckpt_dir:
+        from ..checkpoint import Checkpointer
+        with Checkpointer(os.path.abspath(args.ckpt_dir)) as ckpt:
+            exp.restore_checkpoint(ckpt, step=args.ckpt_step)
+            # resolved, not requested: the integrity fallback may
+            # restore an older retained step than asked for
+            repro["ckpt_step"] = ckpt.last_restored_step
+        print(f"policy restored from {args.ckpt_dir} "
+              f"(step {repro['ckpt_step']})", file=sys.stderr)
+    else:
+        print("note: no --ckpt-dir; serving untrained init weights",
+              file=sys.stderr)
+
+    registry = Registry()
+    bus = None
+    if args.obs_dir:
+        bus = EventBus(os.path.abspath(args.obs_dir), rank=0,
+                       name="serve")
+    scraper = None
+    report: dict = {"repro": repro}
+    try:
+        if args.metrics_port is not None:
+            from ..obs import serve_http
+            scraper = serve_http(registry, port=args.metrics_port)
+            print(f"metrics scrape endpoint: {scraper.url}",
+                  file=sys.stderr)
+        engine = InferenceEngine(exp.apply_fn, exp.train_state.params,
+                                 exp.env_params, max_bucket=args.bucket,
+                                 registry=registry, bus=bus)
+        if args.bench:
+            pool = build_request_pool(exp.apply_fn,
+                                      exp.train_state.params,
+                                      exp.env_params, exp.traces,
+                                      steps=args.pool_steps,
+                                      faults=exp.faults)
+            server = PolicyServer(engine, registry=registry)
+            report["bench"] = run_bench(engine, server, pool,
+                                        rounds=args.rounds,
+                                        request_sizes=sizes)
+            b = report["bench"]
+            print(f"bench: {b['requests']} decisions over "
+                  f"{b['rounds']} dispatches (sizes "
+                  f"{b['request_sizes']} -> buckets {b['buckets']}), "
+                  f"p50 {b['latency_p50_ms']:.2f} ms, "
+                  f"p99 {b['latency_p99_ms']:.2f} ms, "
+                  f"{b['decisions_per_s']:.0f} decisions/s "
+                  f"({b['decisions_per_s_per_chip']:.0f}/chip), "
+                  f"post-warmup recompiles: "
+                  f"{b['post_warmup_recompiles']}", file=sys.stderr)
+        if args.fleet is not None:
+            windows, traces = fleet_windows(cfg, args.fleet,
+                                            source=exp.source)
+            faults = None
+            if args.fleet_regime is not None:
+                faults = sample_fleet_faults(
+                    cfg.n_nodes, args.fleet_regime, args.fleet_seed,
+                    args.fleet, windows)
+            fl = fleet_replay(exp.apply_fn, exp.train_state.params,
+                              exp.env_params, traces, faults=faults,
+                              max_steps=args.max_steps)
+            fl["regime"] = args.fleet_regime
+            fl["fleet_seed"] = (args.fleet_seed
+                                if args.fleet_regime else None)
+            registry.gauge("serve_fleet_mean_jct",
+                           "fleet replay pooled mean JCT").set(
+                fl["mean_jct"])
+            registry.gauge("serve_fleet_completion",
+                           "fleet replay completed fraction").set(
+                fl["completion"])
+            registry.gauge("serve_fleet_decisions_per_s",
+                           "fleet replay decision throughput").set(
+                fl["decisions_per_s"])
+            report["fleet"] = fl
+            print(f"fleet: {fl['n_clusters']} clusters"
+                  + (f" under {args.fleet_regime!r} faults"
+                     if args.fleet_regime else "")
+                  + f", mean JCT {fl['mean_jct']:.1f} s, completion "
+                  f"{fl['completion']:.1%}, {fl['decisions']} decisions "
+                  f"in {fl['wall_s']:.2f} s "
+                  f"({fl['decisions_per_s']:.0f}/s)", file=sys.stderr)
+        if scraper is not None:
+            report["scrape"] = _self_scrape(scraper)
+        if args.obs_dir:
+            registry.write(os.path.join(os.path.abspath(args.obs_dir),
+                                        "metrics.prom"))
+    finally:
+        if scraper is not None:
+            scraper.close()
+        if bus is not None:
+            bus.close()
+    print(json.dumps(report))
+    return report
+
+
+def _self_scrape(scraper) -> dict:
+    """GET the live endpoint once and validate the exposition is
+    well-formed — the smoke proof that a fleet scraper would accept it."""
+    import urllib.request
+    with urllib.request.urlopen(scraper.url, timeout=10) as resp:
+        body = resp.read().decode("utf-8")
+        status = resp.status
+        ctype = resp.headers.get("Content-Type", "")
+    lines = [ln for ln in body.splitlines() if ln]
+    sample_lines = [ln for ln in lines if not ln.startswith("#")]
+    well_formed = (
+        status == 200 and ctype.startswith("text/plain")
+        and all(ln.startswith(("# HELP ", "# TYPE "))
+                or len(ln.split()) == 2 for ln in lines)
+        and any(ln.startswith("serve_") for ln in sample_lines))
+    return {"url": scraper.url, "port": scraper.port, "status": status,
+            "content_type": ctype, "metric_lines": len(sample_lines),
+            "well_formed": bool(well_formed)}
+
+
+if __name__ == "__main__":
+    main()

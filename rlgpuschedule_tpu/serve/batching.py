@@ -1,0 +1,299 @@
+"""Continuous-batching front end: queue -> coalesce -> pad -> scatter.
+
+The TF-Agents batched-environment insight (PAPERS.md: arXiv 1709.02878)
+applied to serving: many independent decision streams become ONE
+dispatch when their observations are stacked along a batch axis. The
+front end's whole job is managing that axis on the host side:
+
+- **coalesce**: pending requests are drained FIFO and rounded up to the
+  next power-of-two *bucket* (``next_bucket``), so the jitted policy
+  step compiles once per bucket instead of once per request count;
+- **pad**: the tail of the bucket is filled with neutral rows (zero
+  observations, all-actions-legal masks — a padded row must never
+  produce ``-inf``-everywhere logits or NaNs, its action is discarded
+  anyway);
+- **scatter**: the batched action array is split back to the submitting
+  requests in FIFO order (``scatter_results`` — the padding+scatter
+  round-trip is property-tested in tests/test_serve.py).
+
+Everything operates on HOST pytrees (numpy leaves, leading request
+axis); device placement is the engine's job, so the queue never holds
+device buffers hostage.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any
+
+import numpy as np
+
+
+def next_bucket(n: int, max_bucket: int) -> int:
+    """The power-of-two batch bucket for ``n`` requests (smallest power
+    of two >= n, capped by ``max_bucket``). Compiling one executable per
+    bucket bounds the jit cache at log2(max_bucket)+1 entries while
+    wasting at most half a batch of padding."""
+    if n <= 0:
+        raise ValueError(f"need at least one request, got {n}")
+    if max_bucket <= 0 or (max_bucket & (max_bucket - 1)):
+        raise ValueError(f"max_bucket must be a positive power of two, "
+                         f"got {max_bucket}")
+    if n > max_bucket:
+        raise ValueError(f"{n} requests exceed max_bucket={max_bucket}; "
+                         f"drain in max_bucket-sized dispatches")
+    return 1 << (n - 1).bit_length()
+
+
+def stack_requests(rows: "list[Any]") -> Any:
+    """Stack per-request pytrees (no leading axis) into one batched host
+    pytree (leading axis = len(rows), FIFO order preserved)."""
+    import jax
+    return jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                        *rows)
+
+
+def pad_batch(batch: Any, bucket: int, fill_mask_true: bool = False) -> Any:
+    """Pad a batched host pytree from n rows up to ``bucket`` rows.
+
+    Padding rows are zeros, EXCEPT boolean leaves when
+    ``fill_mask_true``: action masks pad with every action legal, so the
+    padded rows' logits stay finite under the ``-inf`` masking scheme
+    (an all-masked row is the degenerate case the models never see in
+    training)."""
+    import jax
+
+    def pad(x):
+        x = np.asarray(x)
+        n = x.shape[0]
+        if n > bucket:
+            raise ValueError(f"batch of {n} rows exceeds bucket {bucket}")
+        if n == bucket:
+            return x
+        fill = (np.ones if (fill_mask_true and x.dtype == np.bool_)
+                else np.zeros)
+        return np.concatenate(
+            [x, fill((bucket - n,) + x.shape[1:], x.dtype)])
+
+    return jax.tree.map(pad, batch)
+
+
+def scatter_results(actions: Any, n: int) -> "list[Any]":
+    """Split a batched action pytree back into ``n`` per-request pytrees
+    in submission order, dropping the padding tail."""
+    import jax
+    return [jax.tree.map(lambda x: np.asarray(x)[i], actions)
+            for i in range(n)]
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """What a request's future resolves to."""
+    action: Any            # per-request action pytree (numpy)
+    latency_s: float       # submit -> result, queue wait included
+
+
+@dataclasses.dataclass
+class _Pending:
+    obs: Any
+    mask: Any
+    stall: int
+    t_submit: float
+    future: Future
+
+
+class PolicyServer:
+    """The continuous-batching request queue over one
+    :class:`~.engine.InferenceEngine`.
+
+    ``submit`` enqueues a request and returns a
+    :class:`concurrent.futures.Future` resolving to :class:`ServeResult`;
+    ``pump`` drains up to ``engine.max_bucket`` pending requests into
+    one coalesced dispatch. Drive it either inline (submit-then-pump —
+    deterministic batch composition; what ``serve --bench`` does so its
+    measured dispatch sizes are exactly the request sizes) or via the
+    background dispatcher thread (:meth:`start` / :meth:`stop`) for live
+    continuous batching, where a dispatch grabs whatever is pending the
+    moment the previous one finishes.
+
+    SLO surface (the ``registry`` gauges/counters, re-rendered by both
+    the ``metrics.prom`` snapshot and the live scrape endpoint):
+    ``serve_requests_total``, ``serve_dispatches_total``,
+    ``serve_queue_depth``, ``serve_batch_occupancy`` (real rows /
+    bucket, last dispatch), ``serve_decision_latency_p50_ms`` / ``_p99_ms``
+    and ``serve_decisions_per_s`` (+ ``_per_chip``) via
+    :meth:`slo_snapshot`.
+    """
+
+    def __init__(self, engine, registry=None, latency_window: int = 8192,
+                 clock=time.perf_counter):
+        from ..obs import Registry
+        self.engine = engine
+        self.registry = registry if registry is not None else Registry()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._pending: collections.deque[_Pending] = collections.deque()
+        self._latencies: collections.deque[float] = collections.deque(
+            maxlen=latency_window)
+        self._occupancies: collections.deque[float] = collections.deque(
+            maxlen=latency_window)
+        self._thread: threading.Thread | None = None
+        self._stopped = False
+        self._served = 0
+        self._t_first: float | None = None
+        self._t_last: float | None = None
+        self._requests = self.registry.counter(
+            "serve_requests_total", "scheduling requests submitted")
+        self._dispatches = self.registry.counter(
+            "serve_dispatches_total", "coalesced batch dispatches")
+        self._padded = self.registry.counter(
+            "serve_padded_slots_total",
+            "bucket slots filled with padding instead of requests")
+        self._depth = self.registry.gauge(
+            "serve_queue_depth", "requests waiting after the last drain")
+        self._occupancy = self.registry.gauge(
+            "serve_batch_occupancy",
+            "real rows / bucket rows of the last dispatch")
+
+    def submit(self, obs: Any, mask: Any, stall: int = 0) -> Future:
+        """Enqueue one scheduling request (host pytrees, NO leading batch
+        axis). ``stall`` is the client's consecutive-zero-dt count for
+        the stall gate (preemptive configs; 0 = gate disengaged)."""
+        fut: Future = Future()
+        req = _Pending(obs=obs, mask=mask, stall=int(stall),
+                       t_submit=self._clock(), future=fut)
+        with self._wake:
+            if self._stopped:
+                raise RuntimeError("PolicyServer is stopped")
+            self._pending.append(req)
+            self._requests.inc()
+            self._wake.notify()
+        return fut
+
+    def pump(self) -> int:
+        """Drain one coalesced batch: pop up to ``engine.max_bucket``
+        pending requests (FIFO), pad to the bucket, dispatch, scatter
+        results to their futures. Returns the number of requests served
+        (0 = queue was empty)."""
+        with self._lock:
+            batch = [self._pending.popleft()
+                     for _ in range(min(len(self._pending),
+                                        self.engine.max_bucket))]
+            self._depth.set(len(self._pending))
+        if not batch:
+            return 0
+        n = len(batch)
+        try:
+            obs = stack_requests([r.obs for r in batch])
+            mask = stack_requests([r.mask for r in batch])
+            stall = np.asarray([r.stall for r in batch], np.int32)
+            actions, bucket = self.engine.decide(obs, mask, stall)
+            now = self._clock()
+            per_req = scatter_results(actions, n)
+        except BaseException as e:
+            for r in batch:
+                if not r.future.cancelled():
+                    r.future.set_exception(e)
+            raise
+        self._dispatches.inc()
+        self._padded.inc(bucket - n)
+        self._occupancy.set(n / bucket)
+        self._occupancies.append(n / bucket)
+        with self._lock:
+            if self._t_first is None:
+                self._t_first = min(r.t_submit for r in batch)
+            self._t_last = now
+            self._served += n
+        for r, a in zip(batch, per_req):
+            lat = now - r.t_submit
+            self._latencies.append(lat)
+            r.future.set_result(ServeResult(action=a, latency_s=lat))
+        return n
+
+    # ---- live dispatcher thread --------------------------------------
+
+    def start(self) -> None:
+        """Start the background dispatcher: pump whenever requests are
+        pending (continuous batching — each dispatch coalesces whatever
+        arrived while the previous one ran)."""
+        if self._thread is not None:
+            raise RuntimeError("dispatcher already running")
+        self._stopped = False
+
+        def loop():
+            while True:
+                with self._wake:
+                    while not self._pending and not self._stopped:
+                        self._wake.wait()
+                    if self._stopped and not self._pending:
+                        return
+                self.pump()
+
+        self._thread = threading.Thread(target=loop,
+                                        name="serve-dispatcher",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop the dispatcher after draining the queue. Submits are
+        refused while the drain is in flight; once stopped the server
+        is back in inline mode (submit-then-:meth:`pump`) and
+        :meth:`start` may be called again."""
+        with self._wake:
+            self._stopped = True
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        with self._wake:
+            self._stopped = False
+
+    # ---- SLO surface -------------------------------------------------
+
+    def slo_snapshot(self) -> dict:
+        """Compute and publish the SLO numbers: p50/p99 decision latency
+        (ms), decisions/s and decisions/s/chip over the serving span,
+        mean batch occupancy. Also writes the latency/throughput gauges
+        into the registry so a scrape observes them."""
+        import jax
+        lats = np.asarray(self._latencies, np.float64)
+        span = ((self._t_last - self._t_first)
+                if self._served and self._t_last is not None
+                and self._t_first is not None else 0.0)
+        n_chips = max(jax.local_device_count(), 1)
+        dps = self._served / span if span > 0 else 0.0
+        snap = {
+            "requests": int(self._served),
+            "dispatches": int(self._dispatches.value),
+            "latency_p50_ms": (float(np.percentile(lats, 50)) * 1e3
+                               if lats.size else None),
+            "latency_p99_ms": (float(np.percentile(lats, 99)) * 1e3
+                               if lats.size else None),
+            "decisions_per_s": dps,
+            "decisions_per_s_per_chip": dps / n_chips,
+            "n_chips": n_chips,
+            "batch_occupancy_mean": (float(np.mean(self._occupancies))
+                                     if self._occupancies else None),
+            "serving_span_s": span,
+        }
+        if lats.size:
+            self.registry.gauge(
+                "serve_decision_latency_p50_ms",
+                "median submit->result decision latency").set(
+                snap["latency_p50_ms"])
+            self.registry.gauge(
+                "serve_decision_latency_p99_ms",
+                "p99 submit->result decision latency").set(
+                snap["latency_p99_ms"])
+        self.registry.gauge(
+            "serve_decisions_per_s",
+            "scheduling decisions served per second").set(dps)
+        self.registry.gauge(
+            "serve_decisions_per_s_per_chip",
+            "decisions/s divided by local device count").set(
+            dps / n_chips)
+        return snap

@@ -1,0 +1,114 @@
+"""`serve --bench`: the latency half of the serving SLO story.
+
+Drives a deterministic synthetic request stream through the full
+engine + continuous-batching stack and reports decision-latency
+percentiles, decisions/s(/chip), occupancy, and — the steady-state
+contract — the post-warmup recompile count, which must be ZERO across
+distinct request batch sizes inside one bucket (ISSUE 7 acceptance;
+ci.sh asserts it).
+
+Requests are real observations: a pool is built by resetting the
+config's env windows and stepping them a few decisions under the same
+greedy policy being served, so the benched batches look like live
+cluster snapshots, not zeros.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..decision import policy_decision
+from ..env import env as env_lib
+
+
+def default_request_sizes(bucket: int) -> "tuple[int, ...]":
+    """Three distinct request counts that all coalesce to ``bucket``
+    (i.e. in ``(bucket/2, bucket]``) — the acceptance shape: one
+    compiled program must serve all of them without retracing. Needs
+    ``bucket >= 8`` for three distinct sizes to exist comfortably."""
+    if bucket < 8:
+        raise ValueError(f"default request sizes need bucket >= 8 for "
+                         f"three distinct sizes in (bucket/2, bucket]; "
+                         f"got {bucket} — pass explicit sizes")
+    return (bucket // 2 + 1, (3 * bucket) // 4, bucket)
+
+
+def build_request_pool(apply_fn, net_params: Any, env_params: Any,
+                       traces: Any, steps: int = 4,
+                       faults: Any = None) -> "list[tuple[Any, Any]]":
+    """Materialize a pool of (obs, mask) request rows by stepping the
+    env batch ``steps`` decisions under the greedy policy — every pool
+    entry is a cluster state the policy actually reaches. Host pytrees,
+    no leading axis; pool order is (step, env) row-major."""
+    state, ts = env_lib.vec_reset(env_params, traces, faults)
+    obs, mask = ts.obs, ts.action_mask
+    pool: list[tuple[Any, Any]] = []
+
+    def rows(o, m):
+        o, m = jax.device_get((o, m))
+        n = jax.tree.leaves(o)[0].shape[0]
+        for i in range(n):
+            pool.append((jax.tree.map(lambda x: np.asarray(x)[i], o),
+                         jax.tree.map(lambda x: np.asarray(x)[i], m)))
+
+    rows(obs, mask)
+    for _ in range(max(steps, 0)):
+        actions = policy_decision(apply_fn, net_params, obs, mask)
+        state, ts = env_lib.vec_step(env_params, state, traces, actions,
+                                     faults=faults)
+        obs, mask = ts.obs, ts.action_mask
+        rows(obs, mask)
+    return pool
+
+
+def run_bench(engine, server, pool: "list[tuple[Any, Any]]",
+              rounds: int = 24,
+              request_sizes: "tuple[int, ...] | None" = None) -> dict:
+    """Serve ``rounds`` coalesced dispatches, cycling the request sizes
+    and the pool deterministically, inline-pumped so every dispatch's
+    composition is exactly the round's request size. Returns the SLO
+    report (and leaves the same numbers in the server's registry for
+    the scrape endpoint / .prom snapshot)."""
+    if rounds <= 0:
+        raise ValueError(f"rounds must be positive, got {rounds}")
+    if not pool:
+        raise ValueError("empty request pool")
+    if request_sizes is None:
+        request_sizes = default_request_sizes(engine.max_bucket)
+    request_sizes = tuple(int(s) for s in request_sizes)
+    if any(s <= 0 for s in request_sizes):
+        raise ValueError(f"request sizes must be positive: "
+                         f"{request_sizes}")
+    buckets = sorted({engine.bucket_for(s) for s in request_sizes})
+
+    # pre-pay the per-bucket compiles so the measured rounds are pure
+    # steady state — after this, ANY compile is an alarm
+    obs0, mask0 = pool[0]
+    engine.warmup(obs0, mask0, buckets=tuple(buckets))
+    warm_recompiles = engine.post_warmup_recompiles
+
+    cursor = 0
+    futures = []
+    for r in range(rounds):
+        k = request_sizes[r % len(request_sizes)]
+        for _ in range(k):
+            obs, mask = pool[cursor % len(pool)]
+            futures.append(server.submit(obs, mask))
+            cursor += 1
+        server.pump()
+    results = [f.result(timeout=60) for f in futures]
+
+    snap = server.slo_snapshot()
+    return {
+        "rounds": rounds,
+        "request_sizes": list(request_sizes),
+        "buckets": [int(b) for b in buckets],
+        "pool_size": len(pool),
+        "post_warmup_recompiles":
+            engine.post_warmup_recompiles - warm_recompiles,
+        "warmed_buckets": [int(b) for b in engine.warmed_buckets],
+        **snap,
+        "requests": len(results),
+    }

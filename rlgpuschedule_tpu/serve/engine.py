@@ -1,0 +1,206 @@
+"""The stateless jit'd inference engine behind the policy server.
+
+Podracer's serving recipe (PAPERS.md: arXiv 2104.06272) in one class:
+dedicate the device to ONE program — ``policy_step(params, obs_batch,
+mask_batch) -> actions`` — compiled once per power-of-two batch bucket
+with the request buffers donated at the dispatch boundary, and police
+the steady state with the same sentinels that gate training
+(:mod:`..analysis.sentinels`): any post-warmup trace/compile is a
+``recompile`` alarm, and every post-warmup dispatch runs under
+``jax.transfer_guard("disallow")`` so an implicit host sync in the hot
+path fails loudly instead of silently serializing the pipeline.
+
+The decision rule itself is :func:`..decision.policy_decision` — the
+SAME function ``eval.replay`` scans over, so a served action is
+bit-identical to what evaluation would replay for that observation
+(tests/test_serve.py pins it). Params come from wherever the caller
+restored them — the CLI resolves checkpoints through the existing
+``Checkpointer`` (integrity fallback included) exactly like
+``evaluate`` does, and ``select_checkpoint`` picks the step.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..analysis.sentinels import (CompileCounter, RecompileSentinelError,
+                                  no_implicit_transfers)
+from ..decision import (gate_stalled, policy_decision, preempt_slice,
+                        stall_threshold)
+from .batching import next_bucket, pad_batch
+
+
+class InferenceEngine:
+    """Bucketed, donated, sentinel-policed greedy policy inference.
+
+    ``decide(obs, mask, stall)`` takes HOST pytrees with a leading
+    request axis ``[n, ...]``, pads to the next power-of-two bucket,
+    uploads explicitly (``jax.device_put`` — the transfer the guard
+    allows), dispatches the jitted decision, and returns the first
+    ``n`` actions as host arrays plus the bucket used.
+
+    Compile accounting is per-bucket: the FIRST dispatch of each bucket
+    size is its warmup (the compile is blessed, recorded as a
+    ``compile`` event when a bus is attached — or pre-paid via
+    :meth:`warmup`); any compile activity on a warmed bucket is a
+    **recompile alarm**: the ``serve_recompile_alarms_total`` counter
+    bumps, a ``recompile`` event is emitted, and with ``strict=True``
+    the dispatch raises :class:`RecompileSentinelError`. A bench run
+    asserts the counter stays at zero (ISSUE 7 acceptance).
+    """
+
+    def __init__(self, apply_fn, net_params: Any, env_params: Any = None,
+                 max_bucket: int = 256, registry=None, bus=None,
+                 strict: bool = False, stall_gate: bool = True):
+        from ..obs import Registry
+        if max_bucket <= 0 or (max_bucket & (max_bucket - 1)):
+            raise ValueError(f"max_bucket must be a positive power of "
+                             f"two, got {max_bucket}")
+        self.max_bucket = max_bucket
+        self.strict = strict
+        self.registry = registry if registry is not None else Registry()
+        self._bus = bus
+        self._params = jax.device_put(net_params)
+        pre = (preempt_slice(env_params)
+               if stall_gate and env_params is not None else None)
+        thresh = stall_threshold(env_params) if pre is not None else 0
+        self._has_stall_gate = pre is not None
+        self._warmed: set[int] = set()
+        self._recompiles = self.registry.counter(
+            "serve_recompile_alarms_total",
+            "post-warmup dispatches that traced or compiled")
+        self._compiles = self.registry.counter(
+            "serve_bucket_compiles_total",
+            "blessed per-bucket warmup compiles")
+        # ONE jit per engine, built here and reused every dispatch (the
+        # jsan recompile-hazard discipline); request buffers are donated
+        # — they are per-dispatch transients, and donation lets XLA
+        # reuse their pages for the outputs (the Podracer trick)
+        if self._has_stall_gate:
+            # stall (i32[bucket]) is deliberately NOT donated: it is the
+            # one input whose shape/dtype matches the actions output, so
+            # XLA aliases the two — and on the multi-device CPU backend
+            # a cache-loaded aliased executable corrupts the result (the
+            # same donation hazard checkpoint._fresh_copy documents).
+            # The donation win lives in the big obs/mask request
+            # buffers anyway.
+            def _decide(params, obs, mask, stall):
+                return policy_decision(
+                    apply_fn, params, obs,
+                    gate_stalled(mask, stall, thresh, pre))
+            self._step = jax.jit(_decide, donate_argnums=(1, 2))
+        else:
+            def _decide(params, obs, mask):
+                return policy_decision(apply_fn, params, obs, mask)
+            self._step = jax.jit(_decide, donate_argnums=(1, 2))
+
+    @property
+    def post_warmup_recompiles(self) -> int:
+        return int(self._recompiles.value)
+
+    @property
+    def warmed_buckets(self) -> "tuple[int, ...]":
+        return tuple(sorted(self._warmed))
+
+    def bucket_for(self, n: int) -> int:
+        return next_bucket(n, self.max_bucket)
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self._bus is not None:
+            self._bus.emit(kind, **fields)
+
+    def _dispatch(self, obs_d, mask_d, stall_d, bucket: int):
+        """One guarded dispatch at ``bucket`` (device inputs)."""
+        warm = bucket not in self._warmed
+        args = ((self._params, obs_d, mask_d, stall_d)
+                if self._has_stall_gate
+                else (self._params, obs_d, mask_d))
+        with CompileCounter() as c:
+            if warm:
+                import warnings
+                with warnings.catch_warnings():
+                    # the actions output is smaller than the donated
+                    # request buffers, so backends that can't repurpose
+                    # the pages (CPU) warn per compile — donation is
+                    # still correct (a no-op at worst), the warning is
+                    # compile-time-only noise
+                    warnings.filterwarnings(
+                        "ignore",
+                        message="Some donated buffers were not usable")
+                    out = self._step(*args)
+            else:
+                # steady state: no implicit host<->device traffic either
+                # direction — the dispatch must be pure device work
+                with no_implicit_transfers():
+                    out = self._step(*args)
+        if c.total:
+            if warm:
+                self._compiles.inc()
+                self._emit("compile", scope="serve", bucket=bucket,
+                           traces=c.traces,
+                           backend_compiles=c.backend_compiles)
+            else:
+                self._recompiles.inc()
+                self._emit("recompile", scope="serve", bucket=bucket,
+                           traces=c.traces,
+                           backend_compiles=c.backend_compiles)
+                if self.strict:
+                    raise RecompileSentinelError(
+                        f"serving dispatch at warmed bucket {bucket} "
+                        f"traced/compiled ({c.traces} traces, "
+                        f"{c.backend_compiles} backend compiles): a "
+                        f"steady-state policy server must never "
+                        f"recompile")
+        self._warmed.add(bucket)
+        return out
+
+    def decide(self, obs: Any, mask: Any,
+               stall: "np.ndarray | None" = None) -> "tuple[Any, int]":
+        """Decide one coalesced request batch. ``obs``/``mask`` are host
+        pytrees ``[n, ...]``; ``stall`` is ``i32[n]`` (ignored unless the
+        action space has preempt actions). Returns ``(actions[:n] on
+        host, bucket)``."""
+        n = int(jax.tree.leaves(obs)[0].shape[0])
+        bucket = self.bucket_for(n)
+        obs_p = pad_batch(obs, bucket)
+        mask_p = pad_batch(mask, bucket, fill_mask_true=True)
+        if stall is None:
+            stall = np.zeros(n, np.int32)
+        stall_p = pad_batch(np.asarray(stall, np.int32), bucket)
+        # explicit upload: the one host->device transfer serving performs,
+        # outside the transfer-guarded dispatch by design
+        obs_d = jax.device_put(obs_p)
+        mask_d = jax.device_put(mask_p)
+        stall_d = jax.device_put(stall_p) if self._has_stall_gate else None
+        out = self._dispatch(obs_d, mask_d, stall_d, bucket)
+        actions = jax.device_get(out)       # explicit download, ditto
+        return jax.tree.map(lambda a: a[:n], actions), bucket
+
+    def warmup(self, example_obs: Any, example_mask: Any,
+               buckets: "tuple[int, ...]" = ()) -> "tuple[int, ...]":
+        """Pre-pay the per-bucket compiles with neutral batches shaped
+        from one example request (host pytrees, no leading axis). With
+        no explicit ``buckets``, warms every power of two up to
+        ``max_bucket`` — after this, NO live dispatch should ever
+        compile. Returns the buckets warmed by this call."""
+        if not buckets:
+            buckets = tuple(1 << i
+                            for i in range(self.max_bucket.bit_length()))
+        done = []
+        for b in sorted(set(buckets)):
+            if b != next_bucket(b, self.max_bucket):
+                raise ValueError(f"bucket {b} is not a power of two "
+                                 f"<= max_bucket={self.max_bucket}")
+            if b in self._warmed:
+                continue
+            obs = jax.tree.map(
+                lambda x: np.zeros((b,) + np.asarray(x).shape,
+                                   np.asarray(x).dtype), example_obs)
+            mask = jax.tree.map(
+                lambda x: np.ones((b,) + np.asarray(x).shape,
+                                  np.asarray(x).dtype), example_mask)
+            self.decide(obs, mask, np.zeros(b, np.int32))
+            done.append(b)
+        return tuple(done)

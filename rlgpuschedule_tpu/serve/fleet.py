@@ -1,0 +1,119 @@
+"""Vmapped fleet replay: one checkpoint vs thousands of clusters at once.
+
+The throughput half of the serving story: instead of streaming requests
+through the continuous-batching front end, evaluate the policy against
+``N`` seeded simulated clusters as ONE program — the batched
+``eval.replay`` scan with the cluster index as the batch axis (the
+TF-Agents batched-environment pattern at fleet scale). Because it IS
+``eval.replay`` — same decision rule, same env step, same pooling — a
+fleet replay of N clusters matches N sequential single-cluster
+evaluations bit-for-bit on CPU (the ISSUE 7 acceptance gate,
+tests/test_serve.py), while dispatching once instead of N times.
+
+Optionally each cluster replays under a seeded
+:mod:`..sim.faults` regime (cluster ``e`` draws schedule ``(seed, e)``
+— the chaos matrix's reproducibility contract), so a fleet run doubles
+as a degraded-mode SLO probe.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..env import stack_traces
+from ..env.env import EnvParams
+from ..eval import pooled_avg_jct, replay
+
+
+def fleet_windows(cfg, n_clusters: int, source=None, start: int = 0):
+    """Cut ``n_clusters`` seeded trace windows (one per simulated
+    cluster) from the config's source trace — the same deterministic
+    tiling training/eval use (``experiment.make_env_windows``), so fleet
+    cluster ``e`` is exactly eval window ``start + e``. Returns
+    ``(windows, batched_traces)``."""
+    from ..experiment import (build_env_params, load_source_trace,
+                              make_env_windows)
+    from ..sim.core import SimParams, validate_trace
+    if n_clusters <= 0:
+        raise ValueError(f"n_clusters must be positive, got {n_clusters}")
+    fleet_cfg = dataclasses.replace(cfg, n_envs=n_clusters)
+    if cfg.n_pods > 1:
+        # the hierarchical env windows against the per-pod simulator
+        # shape (mirrors experiment.build_stack's pod_sim)
+        sim_params = SimParams(n_nodes=cfg.n_nodes // cfg.n_pods,
+                               gpus_per_node=cfg.gpus_per_node,
+                               max_jobs=cfg.window_jobs,
+                               queue_len=cfg.queue_len,
+                               n_placements=cfg.n_placements)
+    else:
+        sim_params = build_env_params(cfg).sim
+    if source is None:
+        source = validate_trace(sim_params, load_source_trace(cfg),
+                                clamp=True)
+    windows = make_env_windows(fleet_cfg, source, start)
+    return windows, stack_traces(windows, sim_params)
+
+
+def sample_fleet_faults(n_nodes: int, regime: str, seed: int,
+                        n_clusters: int, windows) -> Any:
+    """Seeded per-cluster fault schedules for a fleet replay: cluster
+    ``e`` draws ``(seed, e)`` over the windows' fault horizon — the same
+    reproducibility tuple ``evaluate --chaos`` records."""
+    from ..sim.faults import (fault_horizon, resolve_regime,
+                              sample_fault_schedule,
+                              stack_fault_schedules)
+    r = resolve_regime(regime)
+    horizon_s = fault_horizon(windows)
+    return stack_fault_schedules(
+        [sample_fault_schedule(n_nodes, r, (seed, e), horizon_s)
+         for e in range(n_clusters)])
+
+
+def fleet_replay(apply_fn, net_params: Any, env_params: Any, traces: Any,
+                 faults: Any = None, max_steps: int | None = None,
+                 stall_guard: bool = True) -> dict:
+    """Replay one checkpoint against the whole cluster batch in a single
+    fused-scan dispatch and report throughput-style SLO numbers.
+
+    Returns the pooled fleet table: ``mean_jct`` (completion-weighted
+    across clusters — bit-identical to pooling N sequential runs),
+    ``completion``, ``decisions`` (total policy decisions taken),
+    ``decisions_per_s`` / ``decisions_per_s_per_chip`` over the
+    measured wall time, and the ``per_cluster`` arrays behind them."""
+    if faults is not None and not isinstance(env_params, EnvParams):
+        raise ValueError("fleet fault regimes apply to flat configs "
+                         "(the hierarchical env has no fault-process "
+                         "support)")
+    t0 = time.perf_counter()
+    res = replay(apply_fn, net_params, env_params, traces,
+                 max_steps=max_steps, stall_guard=stall_guard,
+                 faults=faults)
+    jax.block_until_ready(res)
+    wall = time.perf_counter() - t0
+    mean_jct, completion = pooled_avg_jct(res)
+    steps = np.asarray(res.steps, np.int64)
+    decisions = int(steps.sum())
+    n_chips = max(jax.local_device_count(), 1)
+    dps = decisions / wall if wall > 0 else 0.0
+    return {
+        "n_clusters": int(steps.shape[0]),
+        "mean_jct": mean_jct,
+        "completion": completion,
+        "decisions": decisions,
+        "wall_s": wall,
+        "decisions_per_s": dps,
+        "decisions_per_s_per_chip": dps / n_chips,
+        "n_chips": n_chips,
+        "max_steps": max_steps,
+        "per_cluster": {
+            "avg_jct": [float(x) for x in np.asarray(res.avg_jct)],
+            "n_done": [int(x) for x in np.asarray(res.n_done)],
+            "n_valid": [int(x) for x in np.asarray(res.n_valid)],
+            "steps": [int(x) for x in steps],
+            "makespan": [float(x) for x in np.asarray(res.makespan)],
+        },
+    }
