@@ -52,9 +52,10 @@ echo "=== smoke: observability (3-iter CPU run + merged-timeline report) ==="
 # recompile/transfer alarms after warmup — --strict-alarms asserts both
 # in one exit code (ISSUE 5 acceptance).
 OBS_DIR=$(mktemp -d /tmp/ci_obs.XXXXXX)
+ASYNC_OBS_DIR=$(mktemp -d /tmp/ci_async_obs.XXXXXX)
 CHAOS_JSON=$(mktemp /tmp/ci_chaos.XXXXXX.json)
 SERVE_JSON=$(mktemp /tmp/ci_serve.XXXXXX.json)
-trap 'rm -rf "$OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON"' EXIT
+trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON"' EXIT
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python -m rlgpuschedule_tpu.train --config ppo-mlp-synth64 \
     --iterations 3 --n-envs 4 --n-nodes 2 --gpus-per-node 4 \
@@ -63,6 +64,38 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
     --obs-dir "$OBS_DIR" --alarms > /dev/null
 timeout -k 10 60 env JAX_PLATFORMS=cpu \
     python -m rlgpuschedule_tpu.obs.report "$OBS_DIR" --strict-alarms
+
+echo "=== smoke: async actor-learner (3-iter overlapped run, 2 CPU devices) ==="
+# ISSUE 9 acceptance: a telemetry-instrumented train --async run on a
+# 2-virtual-device CPU rig must (a) pass the same strict-alarms gate as
+# the sync smoke (zero post-warmup recompile/transfer alarms — the
+# engine AOT-compiles both programs up front), and (b) leave a run_end
+# event carrying nonzero actor AND learner phase seconds plus the
+# engine's overlap counter — proof the split actually ran both loops.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python -m rlgpuschedule_tpu.train --config ppo-mlp-synth64 \
+    --async --staleness-bound 1 \
+    --iterations 3 --n-envs 4 --n-nodes 2 --gpus-per-node 4 \
+    --window-jobs 16 --horizon 64 --queue-len 4 --n-steps 8 \
+    --n-epochs 1 --n-minibatches 2 --log-every 1 \
+    --obs-dir "$ASYNC_OBS_DIR" --alarms > /dev/null
+timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.obs.report "$ASYNC_OBS_DIR" --strict-alarms
+python - "$ASYNC_OBS_DIR" <<'EOF'
+import sys
+from rlgpuschedule_tpu.obs import merge_dir
+events = merge_dir(sys.argv[1])
+end = next(e for e in events if e["kind"] == "run_end")
+ph = end["phase_seconds"]
+assert ph.get("actor", 0) > 0 and ph.get("learner", 0) > 0, ph
+assert "async_overlap_s" in end and "async_staleness_max" in end, end
+assert not [e for e in events if e["kind"] == "recompile"], "recompiles"
+print("async smoke ok:", {"actor_s": round(ph["actor"], 3),
+                          "learner_s": round(ph["learner"], 3),
+                          "overlap_s": round(end["async_overlap_s"], 3),
+                          "staleness_max": end["async_staleness_max"]})
+EOF
 
 echo "=== smoke: chaos matrix (2 regimes x policy+SJF, CPU) ==="
 # ISSUE 6 acceptance: a tiny evaluate --chaos matrix must exit 0, keep

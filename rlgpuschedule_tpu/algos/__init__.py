@@ -1,19 +1,24 @@
 """L4 RL algorithms: fused rollouts, GAE, the shared minibatch-geometry
 update engine, PPO, A2C."""
 from .rollout import (Transition, RolloutCarry, PolicyApply, rollout,
-                      init_carry)
-from .update import (resolve_geometry, run_minibatch_epochs,
-                     make_update_step, cast_floating)
+                      init_carry, make_rollout_step,
+                      validate_rollout_geometry)
+from .update import (resolve_geometry, validate_update_geometry,
+                     run_minibatch_epochs, make_update_step, cast_floating)
 from .ppo import (PPOConfig, PPOMetrics, make_train_step as make_ppo_step,
+                  make_learn_step as make_ppo_learn_step,
                   make_train_state, ppo_loss, masked_entropy)
-from .a2c import A2CConfig, A2CMetrics, make_train_step as make_a2c_step
+from .a2c import (A2CConfig, A2CMetrics, make_train_step as make_a2c_step,
+                  make_learn_step as make_a2c_learn_step)
 from . import action_dist
 
 __all__ = [
     "Transition", "RolloutCarry", "PolicyApply", "rollout", "init_carry",
-    "resolve_geometry", "run_minibatch_epochs", "make_update_step",
-    "cast_floating",
-    "PPOConfig", "PPOMetrics", "make_ppo_step", "make_train_state",
-    "ppo_loss", "masked_entropy", "A2CConfig", "A2CMetrics", "make_a2c_step",
+    "make_rollout_step", "validate_rollout_geometry",
+    "resolve_geometry", "validate_update_geometry", "run_minibatch_epochs",
+    "make_update_step", "cast_floating",
+    "PPOConfig", "PPOMetrics", "make_ppo_step", "make_ppo_learn_step",
+    "make_train_state", "ppo_loss", "masked_entropy",
+    "A2CConfig", "A2CMetrics", "make_a2c_step", "make_a2c_learn_step",
     "action_dist",
 ]

@@ -121,6 +121,30 @@ def run_a2c_update(apply_fn: PolicyApply, config: A2CConfig,
     return state, metrics
 
 
+def make_learn_step(apply_fn: PolicyApply, config: A2CConfig,
+                    axis_name: str | None = None):
+    """Build the learn half of the A2C iteration:
+    (train_state, tr, last_value, key) -> (train_state', metrics).
+    Same factoring contract as :func:`ppo.make_learn_step` — the fused
+    train step and the async learner loop compose/jit this identical
+    code (no advantage normalization in A2C, matching the legacy path)."""
+
+    def apply_grads(state: TrainState, grads):
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+        return state.apply_gradients(grads=grads)
+
+    def learn_step(train_state: TrainState, tr: Transition,
+                   last_value: jax.Array, key: jax.Array):
+        advantages, returns = compute_gae(tr.reward, tr.value, tr.done,
+                                          last_value, config.gamma,
+                                          config.gae_lambda)
+        return run_a2c_update(apply_fn, config, train_state, tr,
+                              advantages, returns, key, apply_grads)
+
+    return learn_step
+
+
 def make_train_step(apply_fn: PolicyApply, env_params: EnvParams,
                     config: A2CConfig, axis_name: str | None = None):
     """(train_state, carry, traces, key) -> (train_state', carry', metrics).
@@ -128,23 +152,14 @@ def make_train_step(apply_fn: PolicyApply, env_params: EnvParams,
     ``key`` feeds the update engine's per-epoch minibatch shuffles and is
     untouched at the default 1 × 1 geometry (which consumes no
     randomness), preserving the legacy signature contract."""
-
-    def apply_grads(state: TrainState, grads):
-        if axis_name is not None:
-            grads = jax.lax.pmean(grads, axis_name)
-        return state.apply_gradients(grads=grads)
+    learn_step = make_learn_step(apply_fn, config, axis_name)
 
     def train_step(train_state: TrainState, carry: RolloutCarry, traces,
                    key: jax.Array, faults=None):
         carry, tr, last_value = rollout(apply_fn, train_state.params,
                                         env_params, traces, carry,
                                         config.n_steps, faults)
-        advantages, returns = compute_gae(tr.reward, tr.value, tr.done,
-                                          last_value, config.gamma,
-                                          config.gae_lambda)
-        train_state, metrics = run_a2c_update(
-            apply_fn, config, train_state, tr, advantages, returns, key,
-            apply_grads)
+        train_state, metrics = learn_step(train_state, tr, last_value, key)
         return train_state, carry, metrics
 
     return train_step

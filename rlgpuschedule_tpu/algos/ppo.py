@@ -183,6 +183,35 @@ def _params_of(state):
     return state.params  # TrainState and population.MemberState both
 
 
+def make_learn_step(apply_fn: PolicyApply, config: PPOConfig,
+                    axis_name: str | None = None):
+    """Build the learn half of the PPO iteration:
+    (train_state, tr, last_value, key) -> (train_state', metrics).
+
+    GAE + advantage normalization + the fused minibatch-epoch engine —
+    everything downstream of the rollout. The fused
+    :func:`make_train_step` composes this with :func:`rollout`, and the
+    async engine (:mod:`~rlgpuschedule_tpu.async_engine`) jits it alone
+    on the learner device group, so both paths run literally the same
+    update code (the staleness-bound-0 bit-identity contract)."""
+
+    def apply_grads(state: TrainState, grads):
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+        return state.apply_gradients(grads=grads)
+
+    def learn_step(train_state: TrainState, tr: Transition,
+                   last_value: jax.Array, key: jax.Array):
+        advantages, returns = compute_gae(tr.reward, tr.value, tr.done,
+                                          last_value, config.gamma,
+                                          config.gae_lambda)
+        advantages = normalize_advantages(advantages, axis_name)
+        return run_ppo_epochs(apply_fn, config, train_state, tr,
+                              advantages, returns, key, apply_grads)
+
+    return learn_step
+
+
 def make_train_step(apply_fn: PolicyApply, env_params: EnvParams,
                     config: PPOConfig, axis_name: str | None = None):
     """Build the jittable PPO iteration:
@@ -190,24 +219,14 @@ def make_train_step(apply_fn: PolicyApply, env_params: EnvParams,
 
     ``axis_name``: mesh axis for data-parallel gradient pmean (None =
     single-device)."""
-
-    def apply_grads(state: TrainState, grads):
-        if axis_name is not None:
-            grads = jax.lax.pmean(grads, axis_name)
-        return state.apply_gradients(grads=grads)
+    learn_step = make_learn_step(apply_fn, config, axis_name)
 
     def train_step(train_state: TrainState, carry: RolloutCarry, traces,
                    key: jax.Array, faults=None):
         carry, tr, last_value = rollout(apply_fn, train_state.params,
                                         env_params, traces, carry,
                                         config.n_steps, faults)
-        advantages, returns = compute_gae(tr.reward, tr.value, tr.done,
-                                          last_value, config.gamma,
-                                          config.gae_lambda)
-        advantages = normalize_advantages(advantages, axis_name)
-        train_state, metrics = run_ppo_epochs(
-            apply_fn, config, train_state, tr, advantages, returns, key,
-            apply_grads)
+        train_state, metrics = learn_step(train_state, tr, last_value, key)
         return train_state, carry, metrics
 
     return train_step

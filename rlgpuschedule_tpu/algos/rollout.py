@@ -52,6 +52,42 @@ def init_carry(params: EnvParams, traces, key: jax.Array,
     return RolloutCarry(env_state, ts.obs, ts.action_mask, key)
 
 
+def validate_rollout_geometry(n_steps: int, n_envs: int,
+                              n_devices: int = 1) -> None:
+    """Validate the rollout phase's batch geometry on its own terms —
+    decoupled from the update phase's minibatch constraints
+    (:func:`..algos.update.validate_update_geometry`), because the async
+    actor–learner engine runs the two phases on *different* device
+    groups: the env batch must tile the actor group; whether the
+    flattened [T·E] batch tiles the update's minibatch geometry is the
+    learner group's problem."""
+    if n_steps < 1:
+        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+    if n_envs < 1:
+        raise ValueError(f"n_envs must be >= 1, got {n_envs}")
+    if n_devices > 1 and n_envs % n_devices:
+        raise ValueError(
+            f"n_envs={n_envs} must be divisible by the rollout device "
+            f"group size ({n_devices}) to shard the env batch evenly")
+
+
+def make_rollout_step(apply_fn: PolicyApply, env_params: EnvParams,
+                      n_steps: int):
+    """Build the jittable rollout half of an iteration:
+    (net_params, carry, traces, faults) -> (carry', tr, last_value).
+
+    The fused ``make_train_step`` inlines :func:`rollout` directly; the
+    async engine jits this factory's product alone on the actor device
+    group, so the collection program is byte-for-byte the same scan in
+    both paths."""
+
+    def rollout_step(net_params, carry: RolloutCarry, traces, faults=None):
+        return rollout(apply_fn, net_params, env_params, traces, carry,
+                       n_steps, faults)
+
+    return rollout_step
+
+
 def rollout(apply_fn: PolicyApply, net_params, env_params: EnvParams,
             traces, carry: RolloutCarry, n_steps: int, faults=None,
             ) -> tuple[RolloutCarry, Transition, jax.Array]:

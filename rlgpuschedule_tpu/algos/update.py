@@ -80,6 +80,25 @@ def resolve_geometry(n_epochs: int, n_minibatches: int,
     return n_epochs, n_minibatches, minibatch_size
 
 
+def validate_update_geometry(n_epochs: int, n_minibatches: int,
+                             minibatch_size: int | None, *, n_steps: int,
+                             n_envs: int, n_devices: int = 1
+                             ) -> tuple[int, int, int]:
+    """Validate the update phase's geometry on its own terms — the
+    counterpart of ``algos.rollout.validate_rollout_geometry`` for the
+    async split, where the update runs on a learner device group that
+    need not match the actor group. Checks that the trajectory batch
+    tiles the learner group (the [T, E] env axis is what's sharded) and
+    resolves the minibatch triple against the flattened T·E batch.
+    Returns the resolved ``(n_epochs, n_minibatches, minibatch_size)``."""
+    if n_devices > 1 and n_envs % n_devices:
+        raise ValueError(
+            f"n_envs={n_envs} must be divisible by the update device "
+            f"group size ({n_devices}) to shard the trajectory batch")
+    return resolve_geometry(n_epochs, n_minibatches, minibatch_size,
+                            n_steps * n_envs)
+
+
 def cast_floating(tree: Any, dtype) -> Any:
     """Cast every floating leaf of ``tree`` to ``dtype`` (bool/int leaves
     — action ids, masks, done flags — pass through untouched)."""

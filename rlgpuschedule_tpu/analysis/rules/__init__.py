@@ -18,9 +18,10 @@ class Rule:
 
 def all_rules() -> list[Rule]:
     from . import (donation, host_sync, impure_in_jit, prng_reuse,
-                   recompile, tracer_leak)
-    return [donation.RULE, host_sync.RULE, tracer_leak.RULE,
-            impure_in_jit.RULE, recompile.RULE, prng_reuse.RULE]
+                   recompile, sync_in_loop, tracer_leak)
+    return [donation.RULE, host_sync.RULE, sync_in_loop.RULE,
+            tracer_leak.RULE, impure_in_jit.RULE, recompile.RULE,
+            prng_reuse.RULE]
 
 
 def rule_names() -> list[str]:

@@ -1,0 +1,158 @@
+"""sync-in-loop: per-iteration host materialization in a dispatch loop.
+
+The driver loops' whole throughput contract — sync ``Experiment.run``
+and doubly the async actor/learner engine — is keeping the host AHEAD
+of the device: dispatch the next step, materialize scalars only at a
+log cadence, in ONE batched ``jax.device_get``. A ``.item()`` /
+``float()`` / ``np.asarray()`` on a device value INSIDE the driver loop
+re-serializes every iteration: the host blocks on the device before it
+can dispatch again, and the dispatch pipeline (or the actor/learner
+overlap) is gone. This is the host-side complement of ``host-sync``,
+which only fires inside traced regions.
+
+It fires in NON-traced code, inside a ``for``/``while`` body, on values
+whose device provenance is locally evident: a name assigned from
+calling a ``jax.jit(...)``/``jax.pmap(...)`` result or a ``make_*``
+factory product (the repo's step-function convention — the factories
+return callables that are jitted at the call site). Values pulled
+through ``jax.device_get`` are host copies — the blessed batched
+materialization — and are never flagged, so the fix for a finding is
+also its silencer: batch the pulls into one ``device_get`` per cadence.
+
+A deliberate per-iteration sync (e.g. a convergence check that gates
+the loop) is a one-line suppression with the reason inline::
+
+    loss = float(m["loss"])  # jsan: disable=sync-in-loop -- stop criterion needs the scalar
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+from ..engine import Finding, ModuleContext, SourceFile
+
+# assigning the result of one of these produces a dispatch callable
+_JIT_CALLS = {"jax.jit", "jax.pmap", "equinox.filter_jit"}
+
+_SYNC_METHODS = {"item", "tolist"}
+_SYNC_CALLS = {"numpy.asarray", "numpy.array", "numpy.float32",
+               "numpy.float64", "numpy.int32", "numpy.int64"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+def _terminal(node: ast.AST) -> str | None:
+    """The last identifier of a Name/Attribute chain (``self._step`` ->
+    ``_step``) — dispatch callables are tracked by terminal name so the
+    ``self._rollout = jax.jit(...)`` memoization idiom still counts."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _target_names(target: ast.AST) -> list[str]:
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for elt in target.elts:
+            out.extend(_target_names(elt))
+        return out
+    if isinstance(target, ast.Starred):
+        return _target_names(target.value)
+    name = _terminal(target)
+    return [name] if name else []
+
+
+def _is_factory_call(ctx: ModuleContext, call: ast.Call) -> bool:
+    name = ctx.resolve_call(call)
+    return name is not None and name.split(".")[-1].startswith("make_")
+
+
+def _collect(ctx: ModuleContext):
+    """(dispatch names, device-valued names, host-copy names) from the
+    module's assignments. One flat namespace per module — line-order and
+    scope are deliberately ignored (precision over soundness; reusing a
+    name across roles is its own smell)."""
+    dispatch: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        if ctx.resolve_call(call) in _JIT_CALLS \
+                or _is_factory_call(ctx, call):
+            for t in node.targets:
+                dispatch.update(_target_names(t))
+    device: set[str] = set()
+    host: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        names = [n for t in node.targets for n in _target_names(t)]
+        if ctx.resolve_call(call) == "jax.device_get":
+            host.update(names)
+        elif _terminal(call.func) in dispatch:
+            device.update(names)
+    return dispatch, device, host
+
+
+def _root(node: ast.AST) -> ast.AST:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node
+
+
+def _roots_at_device(node: ast.AST, device: set[str],
+                     host: set[str]) -> bool:
+    root = _root(node)
+    return (isinstance(root, ast.Name) and root.id in device
+            and root.id not in host)
+
+
+def _in_loop(ctx: ModuleContext, node: ast.AST) -> bool:
+    for parent in ctx.ancestors(node):
+        if isinstance(parent, (ast.For, ast.While)):
+            return True
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            return False
+    return False
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    _, device, host = _collect(ctx)
+    if not device:
+        return []
+    findings: list[Finding] = []
+    fix = ("batch the pulls into one jax.device_get at a log cadence, "
+           "or suppress with the reason the loop needs the scalar")
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not _in_loop(ctx, node) \
+                or ctx.in_traced_region(node):
+            continue
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SYNC_METHODS and not node.args \
+                and _roots_at_device(node.func.value, device, host):
+            findings.append(src.finding(
+                node, RULE.name,
+                f".{node.func.attr}() on a dispatch result inside the "
+                f"driver loop blocks the host every iteration; {fix}"))
+            continue
+        name = ctx.resolve_call(node)
+        if len(node.args) == 1 and (name in _SYNC_CALLS
+                                    or name in _CAST_BUILTINS) \
+                and _roots_at_device(node.args[0], device, host):
+            findings.append(src.finding(
+                node, RULE.name,
+                f"{name}() materializes a dispatch result inside the "
+                f"driver loop — a host<->device sync per iteration that "
+                f"serializes the pipeline; {fix}"))
+    return findings
+
+
+RULE = Rule(
+    name="sync-in-loop",
+    summary="per-iteration host sync on dispatch results in driver loops",
+    check=_check)

@@ -1,0 +1,642 @@
+"""Async actor–learner engine (L6): Sebulba-style overlapped
+rollout/update (PAPERS.md: arXiv 2104.06272).
+
+The synchronous loop alternates rollout and update on the same devices,
+idling each phase's silicon during the other. This engine splits the
+device set into an ACTOR group (collects fixed-shape trajectory batches
+with the fused rollout scan) and a LEARNER group (runs the fused
+minibatch-update engine), overlapped through a bounded device-side
+queue:
+
+- **actor thread**: gates on the staleness bound, runs the jitted
+  rollout on the actor mesh, ``device_put``s the batch onto the learner
+  mesh (an EXPLICIT transfer — the hot path stays clean under
+  ``jax.transfer_guard("disallow")``), and blocks when the queue is
+  full (backpressure, never drops).
+- **learner loop** (the CALLER's thread, so exceptions/logging/ckpt
+  hooks behave exactly like ``Experiment.run``): pops batch ``i``,
+  enforces the staleness invariant, splits the learner RNG in the same
+  per-iteration order as the sync loop, runs the jitted
+  ``make_learn_step`` program, and publishes the fresh params back to
+  the actor mesh.
+
+**Staleness semantics.** Batches are indexed ``i = 0, 1, ...`` and
+batch ``i`` feeds update ``i``; after update ``i`` the published
+version is ``i+1``. The actor may not START collecting batch ``i``
+until ``published_version >= i - bound``, and always uses the FRESHEST
+published params (so ``staleness(i) = i - version_used(i) <= bound`` —
+the learner asserts it defensively). ``bound = 0`` is lock-step: every
+batch is collected with fully-fresh params, which — because the split
+rollout/learn programs compose literally the same functions as the
+fused step, and the learner replicates the sync loop's key-split
+order — reproduces ``Experiment.run`` BIT-IDENTICALLY
+(tests/test_async.py pins this).
+
+**Barriers.** Checkpoints and window resamples need a drained queue
+(the carry and traces are shared mutable state). Both loops compute the
+same barrier set from the cadences up front; at a barrier iteration the
+actor parks after collecting that batch, the learner drains/updates
+through it, performs the ckpt/resample, then releases the actor — so
+checkpoints always capture a consistent (state, key, carry) triple and
+resume is deterministic given the drained queue.
+
+A single-device rig runs both roles on the same device
+(``DeviceGroups.shared``): phases overlap only at the host level, but
+every queue/staleness/barrier semantic — and the bound-0 bit-identity —
+is identical, which is what most in-process tests exercise.
+
+**Bit-identity scope.** The bound-0 guarantee holds when the learner
+group has the same device count as the sync baseline's placement (the
+update's batch reductions keep their float summation order). A WIDER
+learner group shards those reductions — allclose, not bitwise, exactly
+like ``parallel.dp`` data-parallel vs single-device.
+
+**Compile-once execution.** Both programs are AOT-compiled at
+construction (``jit(...).lower(...).compile()``) on the caller thread:
+the loops call execute-only Compiled objects, so no jit dispatch-cache
+or persistent compile-cache traffic ever happens on the actor thread
+(the compile cache's file IO is not thread-safe against a concurrently
+dispatching peer), and a geometry change raises a shape error instead
+of silently recompiling mid-run.
+
+**CPU host platform caveat.** XLA:CPU's client is not robust against a
+second execute thread: concurrent execute calls intermittently crash
+(and collective-bearing multi-device programs deadlock), and buffer
+DONATION frees inputs at execute time in a way that races the peer
+thread (heap corruption). On the CPU platform the runner therefore
+serializes device dispatch behind a lock and disables donation — phase
+spans still overlap at the host level (queue/staleness/backpressure
+all behave), but compute does not. Real overlap needs separate non-CPU
+device groups, where the lock is a no-op and donation is on.
+"""
+from __future__ import annotations
+
+import bisect
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .algos import (init_carry, validate_rollout_geometry,
+                    validate_update_geometry)
+from .algos.a2c import make_learn_step as make_a2c_learn_step
+from .algos.ppo import make_learn_step as make_ppo_learn_step
+from .algos.rollout import make_rollout_step
+from .analysis.sentinels import no_implicit_transfers
+from .obs.telemetry import AsyncGauges, OverlapMeter
+from .parallel.dp import put_carry, put_global
+from .parallel.groups import DeviceGroups, split_devices
+from .utils.profiling import SectionTimer
+
+# every blocking wait re-checks abort/progress at this period, and gives
+# up (a clear RuntimeError instead of a silent hang) after stall_timeout_s
+_WAIT_TICK_S = 0.2
+
+
+class StalenessError(RuntimeError):
+    """The learner was handed a batch older than the configured bound —
+    an engine invariant violation (the actor gate should make this
+    impossible), never a user error."""
+
+
+class _Aborted(Exception):
+    """Internal: unwind a loop after the other loop failed."""
+
+
+@dataclasses.dataclass
+class _QueueItem:
+    index: int      # global batch index (== the update that consumes it)
+    version: int    # policy version the batch was collected with
+    batch: Any      # (transitions, last_value) on the LEARNER mesh
+
+
+class TrajectoryQueue:
+    """Bounded blocking FIFO between the actor and learner loops.
+
+    ``put`` blocks while the queue is at capacity (backpressure — a
+    full queue slows the actor down, it never drops a batch); ``get``
+    blocks while empty. ``abort(exc)`` wakes every waiter: blocked
+    ``put``/``get`` calls raise ``_Aborted`` so a failure in either
+    loop unwinds the other instead of deadlocking it. Items hold
+    device arrays (the batch already lives on the learner mesh), so
+    the queue itself never copies — it is depth bookkeeping plus
+    blocking semantics."""
+
+    def __init__(self, capacity: int,
+                 clock: Callable[[], float] = time.monotonic,
+                 stall_timeout_s: float = 300.0):
+        if capacity < 1:
+            raise ValueError(f"queue capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._clock = clock
+        self._stall_timeout_s = stall_timeout_s
+        self._items: list[_QueueItem] = []
+        self._cv = threading.Condition()
+        self._abort_exc: BaseException | None = None
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+    def abort(self, exc: BaseException) -> None:
+        with self._cv:
+            if self._abort_exc is None:
+                self._abort_exc = exc
+            self._cv.notify_all()
+
+    def _wait(self, ready: Callable[[], bool], what: str) -> float:
+        """Wait until ``ready()`` under the held condition; returns the
+        seconds spent blocked."""
+        t0 = self._clock()
+        while not ready():
+            if self._abort_exc is not None:
+                raise _Aborted() from self._abort_exc
+            if self._clock() - t0 > self._stall_timeout_s:
+                raise RuntimeError(
+                    f"TrajectoryQueue.{what} stalled for more than "
+                    f"{self._stall_timeout_s}s (deadlocked peer loop?)")
+            self._cv.wait(_WAIT_TICK_S)
+        if self._abort_exc is not None:
+            raise _Aborted() from self._abort_exc
+        return self._clock() - t0
+
+    def put(self, item: _QueueItem) -> float:
+        """Blocking append; returns seconds spent in backpressure."""
+        with self._cv:
+            waited = self._wait(
+                lambda: len(self._items) < self.capacity, "put")
+            self._items.append(item)
+            self._cv.notify_all()
+            return waited
+
+    def get(self) -> tuple[_QueueItem, float]:
+        """Blocking pop; returns (item, seconds spent waiting)."""
+        with self._cv:
+            waited = self._wait(lambda: len(self._items) > 0, "get")
+            item = self._items.pop(0)
+            self._cv.notify_all()
+            return item, waited
+
+
+class _ParamSlot:
+    """The published-params mailbox: the learner publishes
+    ``(params_on_actor_mesh, version)``; the actor waits for a minimum
+    version and always reads the freshest publication."""
+
+    def __init__(self, params: Any, version: int,
+                 clock: Callable[[], float] = time.monotonic,
+                 stall_timeout_s: float = 300.0):
+        self._params = params
+        self._version = version
+        self._clock = clock
+        self._stall_timeout_s = stall_timeout_s
+        self._cv = threading.Condition()
+        self._abort = False
+
+    @property
+    def version(self) -> int:
+        with self._cv:
+            return self._version
+
+    def abort(self) -> None:
+        with self._cv:
+            self._abort = True
+            self._cv.notify_all()
+
+    def publish(self, params: Any, version: int) -> None:
+        with self._cv:
+            self._params = params
+            self._version = version
+            self._cv.notify_all()
+
+    def wait_for(self, min_version: int) -> tuple[Any, int, float]:
+        """Block until ``version >= min_version``; returns
+        (freshest params, their version, seconds spent gated)."""
+        t0 = self._clock()
+        with self._cv:
+            while self._version < min_version:
+                if self._abort:
+                    raise _Aborted()
+                if self._clock() - t0 > self._stall_timeout_s:
+                    raise RuntimeError(
+                        f"staleness gate stalled waiting for version "
+                        f">= {min_version} (have {self._version})")
+                self._cv.wait(_WAIT_TICK_S)
+            if self._abort:
+                raise _Aborted()
+            return self._params, self._version, self._clock() - t0
+
+
+class AsyncRunner:
+    """The assembled async engine over one :class:`~.experiment.Experiment`.
+
+    Construction ADOPTS the experiment onto the group meshes: traces +
+    rollout carry move to the actor mesh, train state + learner RNG key
+    to the learner mesh (all explicit placements). ``run()`` may be
+    called repeatedly — programs stay compiled, version/batch counters
+    continue — which is how the no-post-warmup-recompile contract is
+    tested.
+
+    ``staleness_bound``: max policy-versions a consumed batch may be
+    behind (0 = lock-step sync twin). ``queue_capacity``: bounded
+    batches in flight past the gate (backpressure blocks the actor
+    when full)."""
+
+    def __init__(self, exp, groups: DeviceGroups | None = None,
+                 staleness_bound: int = 1, queue_capacity: int = 2,
+                 stall_timeout_s: float = 300.0):
+        if staleness_bound < 0:
+            raise ValueError(f"staleness_bound must be >= 0, got "
+                             f"{staleness_bound}")
+        cfg = exp.cfg
+        algo_cfg = cfg.ppo if cfg.algo == "ppo" else cfg.a2c
+        groups = groups if groups is not None else split_devices()
+        # decoupled per-phase geometry validation: each phase against
+        # ITS device group (the whole point of splitting the check)
+        validate_rollout_geometry(algo_cfg.n_steps, cfg.n_envs,
+                                  len(groups.actor))
+        validate_update_geometry(algo_cfg.n_epochs, algo_cfg.n_minibatches,
+                                 algo_cfg.minibatch_size,
+                                 n_steps=algo_cfg.n_steps,
+                                 n_envs=cfg.n_envs,
+                                 n_devices=len(groups.learner))
+        # XLA:CPU's client intermittently segfaults (and, for
+        # collective-bearing multi-device programs, deadlocks) when two
+        # threads execute concurrently, so serialize device dispatch on
+        # the CPU platform. Phase spans still overlap at the host level
+        # — the same accounting the shared-group mode reports — but
+        # real compute overlap needs a non-CPU platform, where the lock
+        # is a no-op.
+        on_cpu = groups.actor[0].platform == "cpu"
+        self._dispatch_lock: Any = (
+            threading.Lock() if on_cpu else contextlib.nullcontext())
+        self.exp = exp
+        self.groups = groups
+        self.staleness_bound = staleness_bound
+        self.queue_capacity = queue_capacity
+        self._stall_timeout_s = stall_timeout_s
+        self._clock = time.monotonic
+
+        make_learn = (make_ppo_learn_step if cfg.algo == "ppo"
+                      else make_a2c_learn_step)
+
+        # adopt the experiment's state onto the group meshes (explicit
+        # placements; the experiment object stays the canonical holder
+        # so save/restore_checkpoint work unchanged)
+        self._arep = groups.actor_replicated()
+        self._aenv = groups.actor_env()
+        self._lrep = groups.learner_replicated()
+        self._lenv = groups.learner_env()
+        self._ltraj = groups.learner_traj()
+        exp.traces = put_global(exp.traces, self._aenv)
+        exp.carry = put_carry(groups.actor_mesh, exp.carry)
+        exp.train_state = put_global(exp.train_state, self._lrep)
+        exp.key = jax.device_put(exp.key, self._lrep)
+        self._faults = (put_global(exp.faults, self._aenv)
+                        if exp.faults is not None else None)
+        exp.faults = self._faults
+
+        # AOT-compile BOTH programs on the construction thread
+        # (``jit(...).lower(...).compile()``): the loops call execute-only
+        # Compiled objects, so neither the jit dispatch machinery nor the
+        # persistent compilation cache — whose file IO is not safe to
+        # drive from the actor thread while the caller thread dispatches —
+        # is ever touched off this thread, and a geometry change raises a
+        # shape error instead of silently recompiling mid-run.
+        # axis_name stays None on both programs: GSPMD derives the
+        # gradient psum / global advantage moments from the shardings,
+        # exactly like parallel.dp.shard_train
+        # donation frees the consumed input buffers at execute time, and
+        # on XLA:CPU that deallocation races the peer loop's thread
+        # (heap corruption — intermittent SIGSEGV/SIGABRT at ~30% per
+        # run on the 8-virtual-device rig, clean with donation off), so
+        # the engine donates only off-CPU; the lock-step bit-identity
+        # does not depend on aliasing
+        rollout_donate = () if on_cpu else (1,)   # the carry
+        learn_donate = () if on_cpu else (0,)     # the train state
+        params_a = jax.device_put(exp.train_state.params, self._arep)
+        rollout_jit = jax.jit(
+            make_rollout_step(exp.apply_fn, exp.env_params,
+                              algo_cfg.n_steps),
+            donate_argnums=rollout_donate)
+        self._rollout = rollout_jit.lower(
+            params_a, exp.carry, exp.traces, self._faults).compile()
+        # the learner program needs a trajectory batch to lower against;
+        # shape it from the rollout's output avals (zeros, freed after)
+        _, tr_s, lv_s = jax.eval_shape(rollout_jit, params_a, exp.carry,
+                                       exp.traces, self._faults)
+        tr0 = jax.device_put(jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), tr_s), self._ltraj)
+        lv0 = jax.device_put(jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), lv_s), self._lenv)
+        # donate the state only (off-CPU): the trajectory leaves go
+        # through a [T, E] -> [B] flatten, so XLA can't alias them
+        # anyway (donating them just warns)
+        self._learn = jax.jit(
+            make_learn(exp.apply_fn, algo_cfg),
+            donate_argnums=learn_donate).lower(
+                exp.train_state, tr0, lv0, exp.key).compile()
+        del tr0, lv0
+
+        # loop state shared across run() calls
+        self._iterations_done = 0
+        self._slot = _ParamSlot(
+            params_a, version=0,
+            clock=self._clock, stall_timeout_s=stall_timeout_s)
+        self.queue = TrajectoryQueue(queue_capacity, clock=self._clock,
+                                     stall_timeout_s=stall_timeout_s)
+        self.overlap = OverlapMeter(clock=self._clock)
+        self._bar_cv = threading.Condition()
+        self._barriers: list[int] = []     # global iteration indices
+        self._barriers_done = 0
+        self._failure: BaseException | None = None
+        # actor-thread-owned accounting, read by the learner at log points
+        self._actor_idle_s = 0.0
+        self._learner_idle_s = 0.0
+        self._staleness_last = 0
+        self._staleness_max = 0
+        self._staleness_sum = 0
+        self._consumed = 0
+
+    # -- barrier plumbing --------------------------------------------------
+
+    def _wait_barriers_before(self, i: int) -> float:
+        """Actor side: park until every barrier < global iteration ``i``
+        has been completed by the learner. Returns seconds parked."""
+        t0 = self._clock()
+        with self._bar_cv:
+            need = bisect.bisect_left(self._barriers, i)
+            while self._barriers_done < need:
+                if self._failure is not None:
+                    raise _Aborted()
+                if self._clock() - t0 > self._stall_timeout_s:
+                    raise RuntimeError(
+                        f"actor stalled at barrier before iteration {i}")
+                self._bar_cv.wait(_WAIT_TICK_S)
+        return self._clock() - t0
+
+    def _complete_barrier(self) -> None:
+        with self._bar_cv:
+            self._barriers_done += 1
+            self._bar_cv.notify_all()
+
+    def _abort(self, exc: BaseException) -> None:
+        self._failure = exc
+        self.queue.abort(exc)
+        self._slot.abort()
+        with self._bar_cv:
+            self._bar_cv.notify_all()
+
+    # -- the actor loop (background thread) --------------------------------
+
+    def _actor_loop(self, base: int, iterations: int,
+                    sections: SectionTimer) -> None:
+        exp = self.exp
+        carry = exp.carry
+        try:
+            for k in range(iterations):
+                i = base + k
+                self._actor_idle_s += self._wait_barriers_before(i)
+                # staleness gate: may not collect batch i until the
+                # learner is within `bound` versions; always take the
+                # freshest publication (ISSUE: "refresh actor params
+                # from the learner at each publish")
+                params, version, gated = self._slot.wait_for(
+                    i - self.staleness_bound)
+                self._actor_idle_s += gated
+                # barrier-park may have replaced the carry (resample)
+                carry = exp.carry
+                with self.overlap.span("actor"), sections("actor"), \
+                        no_implicit_transfers(), self._dispatch_lock:
+                    carry, tr, last_value = self._rollout(
+                        params, carry, exp.traces, self._faults)
+                    # explicit hop onto the learner mesh: the queue is
+                    # device-side, the learner pops ready-to-consume
+                    # buffers
+                    batch = (jax.device_put(tr, self._ltraj),
+                             jax.device_put(last_value, self._lenv))
+                    jax.block_until_ready(batch)
+                exp.carry = carry
+                self._actor_idle_s += self.queue.put(
+                    _QueueItem(index=i, version=version, batch=batch))
+        except _Aborted:
+            pass
+        except BaseException as e:  # surface in the learner thread
+            self._abort(e)
+
+    # -- the learner loop (caller thread) -----------------------------------
+
+    def run(self, iterations: int | None = None, log_every: int = 0,
+            logger: Callable[[int, dict], None] | None = None,
+            ckpt=None, ckpt_every: int = 0,
+            eval_every: int = 0,
+            eval_fn: "Callable[[int], dict] | None" = None,
+            eval_logger: Callable[[int, dict], None] | None = None,
+            telemetry=None) -> dict:
+        """Run ``iterations`` overlapped actor/learner iterations; the
+        hook surface (log/ckpt/eval cadences, telemetry protocol,
+        summary dict) mirrors :meth:`Experiment.run`. Window streaming
+        (``cfg.resample_every``) and checkpoints run at drained-queue
+        barriers."""
+        exp = self.exp
+        cfg = exp.cfg
+        iterations = iterations or cfg.iterations
+        base = self._iterations_done
+        history: list[dict] = []
+        eval_history: list[dict] = []
+        sections = (telemetry.sections if telemetry is not None
+                    else SectionTimer())
+        gauges = (AsyncGauges(telemetry.registry)
+                  if telemetry is not None else None)
+
+        def is_ckpt(b: int) -> bool:
+            return bool(ckpt is not None and ckpt_every
+                        and ((b + 1) % ckpt_every == 0
+                             or b == iterations - 1))
+
+        def is_resample(b: int) -> bool:
+            return bool(cfg.resample_every
+                        and (b + 1) % cfg.resample_every == 0
+                        and b != iterations - 1)
+
+        local_barriers = sorted(b for b in range(iterations)
+                                if is_ckpt(b) or is_resample(b))
+        with self._bar_cv:
+            self._barriers = [base + b for b in local_barriers]
+            self._barriers_done = 0
+        self._failure = None
+
+        if telemetry is not None:
+            telemetry.run_start(
+                loop="async-experiment", config=cfg.name, algo=cfg.algo,
+                iterations=iterations, n_envs=cfg.n_envs,
+                steps_per_iteration=exp.steps_per_iteration,
+                staleness_bound=self.staleness_bound,
+                queue_capacity=self.queue_capacity,
+                actor_devices=[d.id for d in self.groups.actor],
+                learner_devices=[d.id for d in self.groups.learner],
+                shared_group=self.groups.shared)
+
+        t0 = time.monotonic()
+        actor = threading.Thread(
+            target=self._actor_loop, args=(base, iterations, sections),
+            name="async-actor", daemon=True)
+        actor.start()
+        try:
+            for k in range(iterations):
+                b = k  # hook-facing iteration index, as in Experiment.run
+                i = base + k
+                if telemetry is not None:
+                    telemetry.begin_iteration(b)
+                with sections("queue_wait"):
+                    item, waited = self.queue.get()
+                self._learner_idle_s += waited
+                if item.index != i:
+                    raise RuntimeError(
+                        f"queue order violation: expected batch {i}, "
+                        f"got {item.index}")
+                staleness = item.index - item.version
+                if staleness > self.staleness_bound:
+                    raise StalenessError(
+                        f"batch {item.index} was collected at policy "
+                        f"version {item.version} — {staleness} versions "
+                        f"behind, bound is {self.staleness_bound}")
+                self._staleness_last = staleness
+                self._staleness_max = max(self._staleness_max, staleness)
+                self._staleness_sum += staleness
+                self._consumed += 1
+                guard = (telemetry.dispatch(b) if telemetry is not None
+                         else contextlib.nullcontext())
+                tr, last_value = item.batch
+                with self.overlap.span("learner"), sections("learner"), \
+                        guard, self._dispatch_lock:
+                    # the sync loop's per-iteration split, in the same order
+                    exp.key, sub = jax.random.split(exp.key)
+                    state, metrics = self._learn(exp.train_state, tr,
+                                                 last_value, sub)
+                    params_a = jax.device_put(state.params, self._arep)
+                    jax.block_until_ready(params_a)
+                exp.train_state = state
+                self._slot.publish(params_a, i + 1)
+
+                want_log = bool(log_every) and (b % log_every == 0
+                                                or b == iterations - 1)
+                m = None
+                if want_log:
+                    with sections("sync"), self._dispatch_lock:
+                        m = {k2: float(v) for k2, v in
+                             jax.device_get(metrics)._asdict().items()}
+                    history.append({"iteration": b, **m})
+                    if logger is not None:
+                        logger(b, m)
+                    if gauges is not None:
+                        gauges.publish(
+                            queue_depth=len(self.queue),
+                            staleness=self._staleness_last,
+                            actor_idle_s=self._actor_idle_s,
+                            learner_idle_s=self._learner_idle_s,
+                            overlap_s=self.overlap.overlap_s)
+                if eval_fn is not None and eval_every and \
+                        ((b + 1) % eval_every == 0 or b == iterations - 1):
+                    with sections("eval"), self._dispatch_lock:
+                        em = dict(eval_fn(b))
+                    eval_history.append({"iteration": b, **em})
+                    if eval_logger is not None:
+                        eval_logger(b, em)
+                # drained-queue barrier work (actor is parked past i)
+                if is_ckpt(b):
+                    with sections("ckpt"):
+                        exp.save_checkpoint(
+                            ckpt, meta={"iteration": b,
+                                        "async_iteration": i,
+                                        "staleness_bound":
+                                            self.staleness_bound})
+                if is_resample(b):
+                    with sections("resample"):
+                        self._resample()
+                if is_ckpt(b) or is_resample(b):
+                    self._complete_barrier()
+                if telemetry is not None:
+                    telemetry.end_iteration(
+                        b, m if want_log else None,
+                        exp.steps_per_iteration)
+                if self._failure is not None:
+                    raise self._failure
+        except BaseException as e:
+            self._abort(e)
+            actor.join(timeout=30)
+            raise
+        actor.join(timeout=self._stall_timeout_s)
+        if actor.is_alive():
+            exc = RuntimeError("actor thread failed to drain")
+            self._abort(exc)
+            raise exc
+        if self._failure is not None:
+            raise self._failure
+        jax.block_until_ready(exp.train_state.params)
+        self._iterations_done = base + iterations
+        wall = time.monotonic() - t0
+        total_env_steps = iterations * exp.steps_per_iteration
+        async_info = self.async_info()
+        out = {"wall_s": wall, "iterations": iterations,
+               "env_steps": total_env_steps,
+               "env_steps_per_sec": total_env_steps / wall,
+               "window_cursor": exp.window_cursor,
+               "history": history,
+               "phase_seconds": {k: round(v, 6)
+                                 for k, v in sections.report().items()},
+               "async": async_info}
+        if eval_history:
+            out["eval_history"] = eval_history
+        if telemetry is not None:
+            if gauges is not None:
+                gauges.publish(queue_depth=len(self.queue),
+                               staleness=self._staleness_last,
+                               actor_idle_s=self._actor_idle_s,
+                               learner_idle_s=self._learner_idle_s,
+                               overlap_s=self.overlap.overlap_s)
+            telemetry.run_end(
+                iterations=iterations, wall_s=round(wall, 6),
+                env_steps=total_env_steps,
+                env_steps_per_sec=round(out["env_steps_per_sec"], 3),
+                **{f"async_{k2}": v for k2, v in async_info.items()
+                   if not isinstance(v, (list, dict))})
+        return out
+
+    def async_info(self) -> dict:
+        """The engine's overlap/staleness accounting so far."""
+        snap = self.overlap.snapshot()
+        return {
+            "staleness_bound": self.staleness_bound,
+            "queue_capacity": self.queue_capacity,
+            "actor_devices": [d.id for d in self.groups.actor],
+            "learner_devices": [d.id for d in self.groups.learner],
+            "shared_group": self.groups.shared,
+            "overlap_s": snap["overlap_s"],
+            "actor_busy_s": snap.get("busy_actor_s", 0.0),
+            "learner_busy_s": snap.get("busy_learner_s", 0.0),
+            "actor_idle_s": round(self._actor_idle_s, 6),
+            "learner_idle_s": round(self._learner_idle_s, 6),
+            "staleness_max": self._staleness_max,
+            "staleness_mean": (self._staleness_sum / self._consumed
+                               if self._consumed else 0.0),
+        }
+
+    def _resample(self) -> None:
+        """Window streaming at a drained-queue barrier: re-cut the env
+        windows and re-init the carry, keeping every placement on its
+        group mesh (the sync twin is ``Experiment.advance_windows``,
+        which assumes a single placement domain)."""
+        exp = self.exp
+        exp._cut_windows(exp.window_cursor + exp.cfg.n_envs)
+        exp.key, carry_key = jax.random.split(exp.key)
+        carry_key = jax.device_put(carry_key, self._arep)
+        carry = init_carry(exp.env_params, exp.traces, carry_key,
+                           self._faults)
+        exp.carry = jax.tree.map(
+            lambda new, old: jax.device_put(new, old.sharding),
+            carry, exp.carry)

@@ -167,10 +167,22 @@ class Checkpointer:
 
     def __init__(self, directory: str, max_to_keep: int | None = 3,
                  bus=None):
+        # On XLA:CPU, Orbax's background save thread must not exist at
+        # all: jax 0.4.x's CPU client is not thread-safe, and a second
+        # thread touching jax while the main thread dispatches donated
+        # train steps corrupts live device buffers (observed in CI as a
+        # checkpoint labeled with a future step or int32 -1 poison, and
+        # reproduced independently by the async engine's bisects — see
+        # async_engine.py). Merely wait()ing after save() is NOT enough;
+        # the thread's existence during the save window is the hazard.
+        # Accelerator platforms keep async checkpointing: their client
+        # is thread-safe and save latency actually matters there.
         self._mngr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True))
+                max_to_keep=max_to_keep, create=True,
+                enable_async_checkpointing=(
+                    jax.default_backend() != "cpu")))
         self.last_restored_step: int | None = None
         # obs.EventBus (or None): save/restore/fallback/crc-reject events
         # land on the run's timeline so a post-mortem ties a rollback to

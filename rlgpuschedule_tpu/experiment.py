@@ -593,6 +593,36 @@ class Experiment:
                            if watchdog is not None else 0))
         return out
 
+    def run_async(self, iterations: int | None = None, *,
+                  groups=None, staleness_bound: int = 1,
+                  queue_capacity: int = 2, log_every: int = 0,
+                  logger: Callable[[int, dict], None] | None = None,
+                  ckpt=None, ckpt_every: int = 0, eval_every: int = 0,
+                  eval_fn: "Callable[[int], dict] | None" = None,
+                  eval_logger: Callable[[int, dict], None] | None = None,
+                  telemetry=None) -> dict:
+        """Opt-in async actor–learner loop (:mod:`.async_engine`):
+        rollout collection on the actor device group overlaps the
+        minibatch update on the learner group, coupled by a bounded
+        device-side trajectory queue under an explicit staleness bound
+        (``staleness_bound=0`` reproduces :meth:`run` bit-identically).
+        The hook surface matches :meth:`run`; checkpoints and window
+        resamples run at drained-queue barriers so :meth:`restore_checkpoint`
+        + a resumed ``run_async`` stays deterministic. ``groups`` is a
+        :class:`~.parallel.groups.DeviceGroups` (default: split the
+        visible devices). NOTE: construction moves this experiment's
+        state onto the group meshes; reuse the runner (or rebuild) rather
+        than mixing with :meth:`run` afterwards. Watchdog/injector
+        resilience hooks and ``fused_chunk`` are sync-path-only."""
+        from .async_engine import AsyncRunner
+        runner = AsyncRunner(self, groups=groups,
+                             staleness_bound=staleness_bound,
+                             queue_capacity=queue_capacity)
+        return runner.run(iterations, log_every=log_every, logger=logger,
+                          ckpt=ckpt, ckpt_every=ckpt_every,
+                          eval_every=eval_every, eval_fn=eval_fn,
+                          eval_logger=eval_logger, telemetry=telemetry)
+
 
 @dataclasses.dataclass
 class PopulationExperiment:

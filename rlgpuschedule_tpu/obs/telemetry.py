@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 from typing import Any, Callable, Iterator, Mapping
 
@@ -313,3 +314,96 @@ class RunTelemetry:
                 f"host wall seconds spent in the {phase} phase").inc(
                 max(secs, 0.0))
         return delta
+
+
+class OverlapMeter:
+    """Online wall-clock overlap between two busy lanes (the async
+    engine's actor and learner threads).
+
+    Each lane opens/closes spans via :meth:`span`; the meter credits the
+    intersection of concurrently-open spans to ``overlap_s``, each
+    overlapping interval exactly once: when a span ENDS, it claims the
+    intersection with the other lane's open span and advances that
+    lane's credit frontier past the claimed interval, so the other
+    lane's own end event cannot re-claim it. Thread-safe (one lock;
+    span bookkeeping is O(1)) and clock-injectable for tests.
+
+    This is the CI smoke stage's "nonzero overlap" evidence: even on a
+    single core, the two threads' spans interleave around device waits,
+    so a genuinely overlapped engine shows ``overlap_s > 0`` while a
+    serialized one shows ~0.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._open: dict[str, float] = {}      # lane -> actual span start
+        self._frontier: dict[str, float] = {}  # lane -> uncredited start
+        self.busy_s: dict[str, float] = {}
+        self.overlap_s = 0.0
+
+    @contextlib.contextmanager
+    def span(self, lane: str) -> Iterator[None]:
+        t0 = self._clock()
+        with self._lock:
+            self._open[lane] = t0
+            self._frontier[lane] = t0
+        try:
+            yield
+        finally:
+            t1 = self._clock()
+            with self._lock:
+                start = self._open.pop(lane, t1)
+                self.busy_s[lane] = (self.busy_s.get(lane, 0.0)
+                                     + (t1 - start))
+                mine = self._frontier.pop(lane, start)
+                for other in self._open:
+                    lo = max(mine, self._frontier[other])
+                    if t1 > lo:
+                        self.overlap_s += t1 - lo
+                        self._frontier[other] = t1
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            out = {f"busy_{k}_s": round(v, 6)
+                   for k, v in self.busy_s.items()}
+            out["overlap_s"] = round(self.overlap_s, 6)
+            return out
+
+
+class AsyncGauges:
+    """The async engine's metric surface on a :class:`.metrics.Registry`
+    (ISSUE 9 names the quartet): ``queue_depth``, ``param_staleness``,
+    ``actor_idle_s``, ``learner_idle_s``, plus the overlap headline.
+    Only the learner (caller) thread writes these — the actor thread
+    hands its numbers over through the engine's lock-protected state, so
+    the Registry never sees concurrent writers."""
+
+    def __init__(self, registry: Registry):
+        self.queue_depth = registry.gauge(
+            "rlsched_async_queue_depth",
+            "trajectory batches waiting in the actor->learner queue")
+        self.param_staleness = registry.gauge(
+            "rlsched_async_param_staleness",
+            "policy-versions behind of the last consumed batch")
+        self.actor_idle = registry.gauge(
+            "rlsched_async_actor_idle_s",
+            "cumulative seconds the actor spent blocked (staleness gate "
+            "+ full-queue backpressure)")
+        self.learner_idle = registry.gauge(
+            "rlsched_async_learner_idle_s",
+            "cumulative seconds the learner spent waiting on an empty "
+            "queue")
+        self.overlap = registry.gauge(
+            "rlsched_async_overlap_s",
+            "cumulative wall seconds actor and learner were busy "
+            "simultaneously")
+
+    def publish(self, *, queue_depth: int, staleness: int,
+                actor_idle_s: float, learner_idle_s: float,
+                overlap_s: float) -> None:
+        self.queue_depth.set(queue_depth)
+        self.param_staleness.set(staleness)
+        self.actor_idle.set(round(actor_idle_s, 6))
+        self.learner_idle.set(round(learner_idle_s, 6))
+        self.overlap.set(round(overlap_s, 6))

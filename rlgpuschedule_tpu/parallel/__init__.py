@@ -4,6 +4,7 @@ from .mesh import (make_mesh, replicated, env_sharded, pop_sharded,
                    pop_env_sharded, DATA_AXIS, POP_AXIS)
 from .dp import (shard_train, shard_map_train, carry_sharding_prefix,
                  put_carry)
+from .groups import DeviceGroups, split_devices, parse_group_spec
 from .population import (HParams, MemberState, init_member,
                          make_member_step, make_population_step,
                          jit_population_step, population_shardings,
@@ -15,6 +16,7 @@ __all__ = [
     "make_mesh", "replicated", "env_sharded", "pop_sharded",
     "pop_env_sharded", "DATA_AXIS", "POP_AXIS",
     "shard_train", "shard_map_train", "carry_sharding_prefix", "put_carry",
+    "DeviceGroups", "split_devices", "parse_group_spec",
     "HParams", "MemberState", "init_member", "make_member_step",
     "make_population_step", "jit_population_step", "population_shardings",
     "sample_hparams", "stack_members",

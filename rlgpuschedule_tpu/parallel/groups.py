@@ -1,0 +1,158 @@
+"""Actor/learner device-group plumbing for the async engine (L5).
+
+The Sebulba split (PAPERS.md: arXiv 2104.06272) dedicates one subset of
+the chips to acting (rollout collection) and a disjoint subset to
+learning (the minibatch update), with trajectories crossing between
+them through a bounded queue. This module owns the *static* half of
+that design: carving the visible device list into the two groups and
+giving each its own 1-axis data mesh, so every downstream sharding
+(replicated params, env-sharded carry/traces, [T, E]-sharded
+trajectories) is the same GSPMD vocabulary :mod:`~.mesh` and
+:mod:`~.dp` already speak — a group of size 1 and a group of size N
+run identical code.
+
+A single-device rig is allowed to run both roles on the SAME device
+(``shared=True``): the phases then only overlap at the host level
+(dispatch pipelining), but the queue/staleness semantics — and the
+bound-0 bit-identity contract — are exactly the same, which is what
+the in-process tests exercise.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+
+def parse_group_spec(spec: str | int | None):
+    """Parse a CLI device-group spec: an int (or digit string) is a
+    device COUNT; a comma-separated string ("0,2,3") is explicit device
+    indices. Returns ``None`` (defaulted), an int count, or a list of
+    indices."""
+    if spec is None:
+        return None
+    if isinstance(spec, int):
+        return spec
+    s = spec.strip()
+    if "," in s:
+        try:
+            return [int(p) for p in s.split(",") if p.strip() != ""]
+        except ValueError:
+            raise ValueError(f"bad device-group spec {spec!r}: comma form "
+                             f"must be integer device indices") from None
+    try:
+        return int(s)
+    except ValueError:
+        raise ValueError(f"bad device-group spec {spec!r}: expected a "
+                         f"count or comma-separated indices") from None
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceGroups:
+    """The actor/learner split plus each group's 1-axis data mesh."""
+    actor: tuple
+    learner: tuple
+    actor_mesh: Mesh
+    learner_mesh: Mesh
+    shared: bool  # both roles on the same device set (1-device rigs)
+
+    # -- sharding vocabulary, per group --------------------------------
+    def actor_replicated(self) -> NamedSharding:
+        return NamedSharding(self.actor_mesh, P())
+
+    def actor_env(self) -> NamedSharding:
+        """[E, ...] arrays, env axis split over the actor group."""
+        return NamedSharding(self.actor_mesh, P(DATA_AXIS))
+
+    def learner_replicated(self) -> NamedSharding:
+        return NamedSharding(self.learner_mesh, P())
+
+    def learner_env(self) -> NamedSharding:
+        return NamedSharding(self.learner_mesh, P(DATA_AXIS))
+
+    def learner_traj(self) -> NamedSharding:
+        """[T, E, ...] trajectory batches: env axis split over the
+        learner group (the time axis stays whole — the update flattens
+        T into the batch)."""
+        return NamedSharding(self.learner_mesh, P(None, DATA_AXIS))
+
+    def actor_traj(self) -> NamedSharding:
+        return NamedSharding(self.actor_mesh, P(None, DATA_AXIS))
+
+    def describe(self) -> str:
+        if self.shared:
+            return (f"shared group: {len(self.actor)} device(s) "
+                    f"{[d.id for d in self.actor]}")
+        return (f"actor {[d.id for d in self.actor]} | "
+                f"learner {[d.id for d in self.learner]}")
+
+
+def _resolve(spec, devices, taken_from_front: bool):
+    """Turn a parsed spec into a concrete device list."""
+    if isinstance(spec, int):
+        if not 1 <= spec <= len(devices):
+            raise ValueError(f"group count {spec} out of range for "
+                             f"{len(devices)} visible devices")
+        return devices[:spec] if taken_from_front else devices[-spec:]
+    ids = {d.id: d for d in devices}
+    out = []
+    for i in spec:
+        if i not in ids:
+            raise ValueError(f"device index {i} not among visible device "
+                             f"ids {sorted(ids)}")
+        out.append(ids[i])
+    if len(set(spec)) != len(spec):
+        raise ValueError(f"duplicate device index in group spec {spec}")
+    if not out:
+        raise ValueError("empty device group")
+    return out
+
+
+def split_devices(actor: str | int | list | None = None,
+                  learner: str | int | list | None = None,
+                  devices=None) -> DeviceGroups:
+    """Carve the visible devices into actor/learner groups.
+
+    Defaults: one visible device → both roles share it; otherwise the
+    first half acts and the second half learns (rollout is the wider
+    phase on the CPU workload, so ties round the extra device to the
+    actor). Explicit specs (counts or index lists, see
+    :func:`parse_group_spec`) must be disjoint — EXCEPT when both name
+    the identical set, which requests a shared group explicitly."""
+    devices = list(devices if devices is not None else jax.local_devices())
+    n = len(devices)
+    actor = parse_group_spec(actor) if isinstance(actor, (str, type(None))) \
+        else actor
+    learner = parse_group_spec(learner) \
+        if isinstance(learner, (str, type(None))) else learner
+
+    if actor is None and learner is None:
+        if n == 1:
+            a = l = devices
+        else:
+            a, l = devices[:(n + 1) // 2], devices[(n + 1) // 2:]
+    elif actor is None:
+        l = _resolve(learner, devices, taken_from_front=False)
+        a = [d for d in devices if d not in l] or l
+    elif learner is None:
+        a = _resolve(actor, devices, taken_from_front=True)
+        l = [d for d in devices if d not in a] or a
+    else:
+        a = _resolve(actor, devices, taken_from_front=True)
+        l = _resolve(learner, devices, taken_from_front=False)
+
+    shared = set(a) == set(l)
+    if not shared and set(a) & set(l):
+        raise ValueError(
+            f"actor and learner groups overlap ({[d.id for d in a]} vs "
+            f"{[d.id for d in l]}): groups must be disjoint, or identical "
+            f"to request an explicitly shared group")
+    return DeviceGroups(
+        actor=tuple(a), learner=tuple(l),
+        actor_mesh=Mesh(np.asarray(a), (DATA_AXIS,)),
+        learner_mesh=Mesh(np.asarray(l), (DATA_AXIS,)),
+        shared=shared)

@@ -31,6 +31,19 @@ Usage::
         [--bf16-update]
     python -m rlgpuschedule_tpu.profile_breakdown [--cpu] \
         --sweep-minibatch [--sweep-out sweep.json]
+    python -m rlgpuschedule_tpu.profile_breakdown [--cpu] \
+        --async [--staleness-bound 1] [--async-out async.json]
+
+``--async`` swaps the stage breakdown for a sync-vs-async PHASE table:
+the same workload is run through the per-iteration sync loop and through
+the overlapped actor-learner engine (``async_engine.AsyncRunner``), and
+the artifact reports seconds/iteration for both plus the engine's own
+phase accounting — actor / learner busy seconds, queue-wait (the actor's
+staleness-gate stall + the learner's pop stall), and the overlap-ceiling
+projection ``(actor + learner) / max(actor, learner)`` that bounds the
+achievable speedup on hardware with enough cores to truly overlap. With
+``--cpu`` this mode pins TWO virtual CPU devices (the split needs
+disjoint actor/learner groups; the plain breakdown pins one).
 
 Prints one JSON object with per-stage seconds/iteration, the stage shares,
 an env-steps/s figure, and a model-FLOPs/s estimate (policy fwd+bwd FLOPs
@@ -142,6 +155,75 @@ def _sweep_minibatch(args, ppo, platform, kind, peak, B, n_params,
     return out
 
 
+def _profile_async(args, cfg, platform) -> dict:
+    """Sync-vs-async phase table on one workload.
+
+    Times the per-iteration sync loop and the overlapped engine
+    (median-of-N, same noise discipline as the stage breakdown), then
+    folds in the engine's own accounting: per-phase host seconds from the
+    run's SectionTimer (``actor``/``learner``/``queue_wait``/``sync``)
+    and the cumulative overlap/staleness counters from ``async_info()``.
+    ``projected_overlap_speedup`` is the phase-time ceiling
+    ``(actor + learner) / max(actor, learner)`` — what perfect overlap
+    would buy on hardware with spare host cores; the measured ``speedup``
+    is what THIS host delivers (≈1.0 or below on a single core, where the
+    CPU dispatch lock serializes the two loops by design)."""
+    import os
+
+    from rlgpuschedule_tpu.async_engine import AsyncRunner
+    from rlgpuschedule_tpu.experiment import Experiment
+
+    n = args.iters_per_repeat
+    sync_exp = Experiment.build(cfg)
+    sync_exp.run(iterations=1)                     # compile + warm
+    t_sync = _median_time(lambda: sync_exp.run(iterations=n),
+                          args.repeats) / n
+
+    async_exp = Experiment.build(cfg)
+    runner = AsyncRunner(async_exp, staleness_bound=args.staleness_bound)
+    runner.run(iterations=1)                       # warm the engine path
+    last: dict = {}
+
+    def timed():
+        last.update(runner.run(iterations=n))
+
+    t_async = _median_time(timed, args.repeats) / n
+    phases = last["phase_seconds"]                 # last timed run only
+    info = last["async"]                           # cumulative counters
+    busy_a = phases.get("actor", 0.0)
+    busy_l = phases.get("learner", 0.0)
+    parts = busy_a + busy_l
+    return {
+        "profile": "async-phase-table",
+        "platform": platform,
+        "cores": os.cpu_count(),
+        "n_envs": cfg.n_envs, "n_steps": cfg.ppo.n_steps,
+        "iters_per_repeat": n, "repeats": args.repeats,
+        "staleness_bound": args.staleness_bound,
+        "groups": runner.groups.describe(),
+        "seconds_per_iteration": {
+            "sync_loop": round(t_sync, 5),
+            "async_loop": round(t_async, 5)},
+        "speedup": round(t_sync / t_async, 3),
+        "async_phase_seconds_per_iteration": {
+            k: round(v / n, 5) for k, v in sorted(phases.items())},
+        "async_phase_share_of_busy": {
+            "actor": round(busy_a / parts, 3) if parts else None,
+            "learner": round(busy_l / parts, 3) if parts else None},
+        "projected_overlap_speedup": round(
+            parts / max(busy_a, busy_l), 3) if parts else None,
+        "queue_wait_s_cumulative": {
+            "actor_idle": info["actor_idle_s"],
+            "learner_idle": info["learner_idle_s"]},
+        "staleness": {"max": info["staleness_max"],
+                      "mean": info["staleness_mean"]},
+        "overlap_s_cumulative": info["overlap_s"],
+        "note": "phase seconds are the last timed run's SectionTimer; "
+                "queue_wait/overlap/staleness counters are cumulative "
+                "over warmup + all repeats",
+    }
+
+
 def main(argv: list[str] | None = None) -> dict:
     ap = argparse.ArgumentParser(prog="rlgpuschedule_tpu.profile_breakdown")
     ap.add_argument("--cpu", action="store_true",
@@ -176,13 +258,27 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--trace-dir", default=None,
                     help="also capture a jax.profiler trace of the fused "
                          "loop here")
+    ap.add_argument("--async", dest="async_run", action="store_true",
+                    help="profile the overlapped actor-learner engine "
+                         "against the sync loop (phase table) instead of "
+                         "the stage breakdown")
+    ap.add_argument("--staleness-bound", type=int, default=1,
+                    help="with --async: the engine's staleness bound")
+    ap.add_argument("--async-out", default=None,
+                    help="with --async: also write the phase-table "
+                         "artifact to this path")
     args = ap.parse_args(argv)
     if args.sweep_out and not args.sweep_minibatch:
         ap.error("--sweep-out only applies with --sweep-minibatch")
+    if args.async_out and not args.async_run:
+        ap.error("--async-out only applies with --async")
+    if args.async_run and (args.sweep_minibatch or args.trace_dir):
+        ap.error("--async is exclusive with --sweep-minibatch/--trace-dir")
 
     if args.cpu:
         from rlgpuschedule_tpu.utils.platform import force_cpu
-        force_cpu(1)
+        # the async split needs disjoint actor/learner device groups
+        force_cpu(2 if args.async_run else 1)
     from rlgpuschedule_tpu.utils.platform import enable_compile_cache
 
     enable_compile_cache()
@@ -209,6 +305,13 @@ def main(argv: list[str] | None = None) -> dict:
                     minibatch_size=args.minibatch_size,
                     bf16_update=args.bf16_update)
     cfg = dataclasses.replace(PPO_MLP_SYNTH64, n_envs=n_envs, ppo=ppo)
+    if args.async_run:
+        out = _profile_async(args, cfg, platform)
+        print(json.dumps(out))
+        if args.async_out:
+            with open(args.async_out, "w") as f:
+                json.dump(out, f, indent=1)
+        return out
     exp = Experiment.build(cfg)
     env_params, apply_fn = exp.env_params, exp.apply_fn
     state, carry, traces = exp.train_state, exp.carry, exp.traces

@@ -129,10 +129,13 @@ class PolicyServer:
     """
 
     def __init__(self, engine, registry=None, latency_window: int = 8192,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, max_wait_s: float | None = None):
         from ..obs import Registry
         self.engine = engine
         self.registry = registry if registry is not None else Registry()
+        if max_wait_s is not None and max_wait_s < 0:
+            raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
+        self.max_wait_s = max_wait_s
         self._clock = clock
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -174,12 +177,31 @@ class PolicyServer:
             self._wake.notify()
         return fut
 
-    def pump(self) -> int:
+    def pump(self, max_wait_s: float | None = None) -> int:
         """Drain one coalesced batch: pop up to ``engine.max_bucket``
         pending requests (FIFO), pad to the bucket, dispatch, scatter
         results to their futures. Returns the number of requests served
-        (0 = queue was empty)."""
+        (0 = queue was empty).
+
+        ``max_wait_s`` (default: the constructor's knob; ``None`` = no
+        wait) is the batching deadline: a PARTIAL bucket holds off
+        dispatching until either the bucket fills or the OLDEST pending
+        request has waited that long — trading a bounded latency floor
+        for occupancy (the classic continuous-batching knob). ``0``
+        keeps the dispatch-whatever-is-pending behavior while still
+        being explicit about it. A :meth:`stop` drain cuts the wait
+        short so shutdown never hangs on a sparse queue."""
+        if max_wait_s is None:
+            max_wait_s = self.max_wait_s
         with self._lock:
+            if max_wait_s is not None and self._pending:
+                deadline = self._pending[0].t_submit + max_wait_s
+                while (len(self._pending) < self.engine.max_bucket
+                       and not self._stopped):
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        break
+                    self._wake.wait(timeout=remaining)
             batch = [self._pending.popleft()
                      for _ in range(min(len(self._pending),
                                         self.engine.max_bucket))]

@@ -102,6 +102,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--bf16-update", action="store_true", default=None,
                    help="bf16-compute / fp32-optimizer-state update path "
                         "(NOT bit-identical to the fp32 default)")
+    # async actor-learner split (async_engine; opt-in)
+    p.add_argument("--async", dest="async_run", action="store_true",
+                   help="overlapped actor-learner engine: rollout "
+                        "collection on one device group overlaps the "
+                        "minibatch update on another, coupled by a "
+                        "bounded device-side trajectory queue "
+                        "(Sebulba split). Single-run configs only; "
+                        "--staleness-bound 0 reproduces the sync loop "
+                        "bit-identically")
+    p.add_argument("--actor-devices", default=None, metavar="N|I,J,..",
+                   help="with --async: actor group as a device COUNT "
+                        "(taken from the front of the visible list) or "
+                        "explicit comma-separated device indices. "
+                        "Default: first half (one device: shared group)")
+    p.add_argument("--learner-devices", default=None, metavar="N|I,J,..",
+                   help="with --async: learner group (count from the "
+                        "back, or explicit indices; must be disjoint "
+                        "from the actor group unless identical)")
+    p.add_argument("--staleness-bound", type=int, default=1,
+                   help="with --async: max update-steps the policy that "
+                        "collected a batch may lag the learner at "
+                        "consume time (0 = lock-step, bit-identical to "
+                        "the sync path; default 1)")
+    p.add_argument("--queue-capacity", type=int, default=2,
+                   help="with --async: trajectory-queue slots; a full "
+                        "queue blocks the actor (backpressure, no drops)")
     # population / PBT (config 5)
     p.add_argument("--pbt", action="store_true",
                    help="train a PBT population instead of a single run")
@@ -409,6 +435,38 @@ def main(argv: list[str] | None = None) -> dict:
         if args.pbt:
             sys.exit("--faults applies to single-run configs (the "
                      "population step does not thread fault schedules)")
+    if not args.async_run:
+        for flag, val, default in (("--actor-devices",
+                                    args.actor_devices, None),
+                                   ("--learner-devices",
+                                    args.learner_devices, None),
+                                   ("--staleness-bound",
+                                    args.staleness_bound, 1),
+                                   ("--queue-capacity",
+                                    args.queue_capacity, 2)):
+            if val != default:
+                sys.exit(f"{flag} configures the async engine; pass "
+                         f"--async with it (refusing the silent no-op)")
+    else:
+        if args.pbt:
+            sys.exit("--async applies to single-run configs (the PBT "
+                     "loop interleaves host-side exploit/explore "
+                     "between steps)")
+        if args.fused_chunk > 1:
+            sys.exit("--fused-chunk fuses the SYNC loop's dispatches; "
+                     "the async engine already overlaps phases — pick "
+                     "one")
+        if args.max_rollbacks is not None:
+            sys.exit("--max-rollbacks (divergence watchdog) is "
+                     "sync-path-only for now; run --async without it")
+        if args.fault:
+            sys.exit("--fault injection hooks the sync loop's "
+                     "iteration boundary; it is not threaded through "
+                     "the async engine")
+        if args.staleness_bound < 0:
+            sys.exit("--staleness-bound must be >= 0")
+        if args.queue_capacity < 1:
+            sys.exit("--queue-capacity must be >= 1")
     if args.alarms and not args.obs_dir:
         sys.exit("--alarms requires --obs-dir (alarm events need an "
                  "event stream to land in)")
@@ -556,9 +614,24 @@ def main(argv: list[str] | None = None) -> dict:
             run_kw["telemetry"] = telemetry
         from .resilience import DivergenceError
         try:
-            out = exp.run(log_every=args.log_every, logger=logger,
-                          ckpt=ckpt, ckpt_every=args.ckpt_every, **eval_kw,
-                          **run_kw)
+            if args.async_run:
+                from .parallel import split_devices
+                groups = split_devices(actor=args.actor_devices,
+                                       learner=args.learner_devices)
+                print(f"async actor-learner: {groups.describe()} "
+                      f"staleness_bound={args.staleness_bound} "
+                      f"queue_capacity={args.queue_capacity}",
+                      file=sys.stderr)
+                out = exp.run_async(
+                    groups=groups, staleness_bound=args.staleness_bound,
+                    queue_capacity=args.queue_capacity,
+                    log_every=args.log_every, logger=logger,
+                    ckpt=ckpt, ckpt_every=args.ckpt_every, **eval_kw,
+                    **run_kw)
+            else:
+                out = exp.run(log_every=args.log_every, logger=logger,
+                              ckpt=ckpt, ckpt_every=args.ckpt_every,
+                              **eval_kw, **run_kw)
         except DivergenceError as e:
             # the watchdog's clean give-up: budget exhausted, state rolled
             # back — a non-zero exit with the reason, not a traceback

@@ -1,0 +1,21 @@
+"""Known-bad: per-iteration host syncs in a dispatch loop (3 findings)."""
+import jax
+import numpy as np
+
+
+def make_train_step(apply_fn):
+    def train_step(state, batch):
+        return apply_fn(state, batch), {"loss": batch.sum()}
+
+    return train_step
+
+
+def drive(apply_fn, state, batches):
+    train_step = make_train_step(apply_fn)
+    losses = []
+    for batch in batches:
+        state, metrics = train_step(state, batch)
+        losses.append(float(metrics["loss"]))   # finding: float per iter
+        print(metrics["loss"].item())           # finding: .item per iter
+        np.asarray(state)                       # finding: asarray per iter
+    return state, losses

@@ -27,9 +27,11 @@ BAD = {
     "impure-in-jit": ("bad_impure.py", 3),
     "recompile-hazard": ("bad_recompile.py", 2),
     "prng-key-reuse": ("bad_prng_reuse.py", 3),
+    "sync-in-loop": ("bad_sync_in_loop.py", 3),
 }
 GOOD = ["good_donation.py", "good_host_sync.py", "good_tracer_leak.py",
-        "good_impure.py", "good_recompile.py", "good_prng_reuse.py"]
+        "good_impure.py", "good_recompile.py", "good_prng_reuse.py",
+        "good_sync_in_loop.py"]
 
 
 def _cli(*args, cwd=REPO):

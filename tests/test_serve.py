@@ -275,6 +275,56 @@ class TestPolicyServer:
         assert "serve_requests_total 5" in rendered
         assert "serve_decision_latency_p99_ms" in rendered
 
+    def test_pump_max_wait_dispatches_partial_after_deadline(self, exp):
+        import time
+        obs, mask = host_requests(exp)
+        engine = InferenceEngine(exp.apply_fn, exp.train_state.params,
+                                 exp.env_params, max_bucket=8)
+        engine.warmup(obs[0], mask[0], buckets=(2, 8))
+        server = PolicyServer(engine)
+        futs = [server.submit(obs[i], mask[i]) for i in range(2)]
+        t0 = time.perf_counter()
+        assert server.pump(max_wait_s=0.2) == 2   # partial bucket, held
+        waited = time.perf_counter() - t0
+        assert waited >= 0.15                      # sat out the deadline
+        assert all(f.result(timeout=10) for f in futs)
+        # a FULL bucket never waits on the deadline
+        futs = [server.submit(obs[i % obs.shape[0]],
+                              mask[i % mask.shape[0]]) for i in range(8)]
+        t0 = time.perf_counter()
+        assert server.pump(max_wait_s=30.0) == 8
+        assert time.perf_counter() - t0 < 5.0
+        assert all(f.result(timeout=10) for f in futs)
+
+    def test_pump_max_wait_cut_short_when_bucket_fills(self, exp):
+        import threading
+        import time
+        obs, mask = host_requests(exp)
+        engine = InferenceEngine(exp.apply_fn, exp.train_state.params,
+                                 exp.env_params, max_bucket=2)
+        engine.warmup(obs[0], mask[0], buckets=(1, 2))
+        server = PolicyServer(engine)
+        server.submit(obs[0], mask[0])
+        late = threading.Timer(0.1, server.submit, (obs[1], mask[1]))
+        late.start()
+        try:
+            t0 = time.perf_counter()
+            assert server.pump(max_wait_s=60.0) == 2   # filled mid-wait
+            assert time.perf_counter() - t0 < 30.0
+        finally:
+            late.cancel()
+        assert server.pump() == 0
+
+    def test_max_wait_ctor_knob_validates_and_reaches_pump(self, exp):
+        obs, mask = host_requests(exp)
+        engine = InferenceEngine(exp.apply_fn, exp.train_state.params,
+                                 exp.env_params, max_bucket=8)
+        with pytest.raises(ValueError, match="max_wait_s"):
+            PolicyServer(engine, max_wait_s=-1.0)
+        server = PolicyServer(engine, max_wait_s=0.0)   # explicit no-wait
+        server.submit(obs[0], mask[0])
+        assert server.pump() == 1                       # ctor default used
+
     def test_background_dispatcher_serves_and_stops(self, exp):
         obs, mask = host_requests(exp)
         engine = InferenceEngine(exp.apply_fn, exp.train_state.params,
