@@ -105,6 +105,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "profile_breakdown --sweep-minibatch artifact "
                         "(its 'best' entry; explicit geometry flags are "
                         "refused alongside it)")
+    p.add_argument("--mesh", default="off", metavar="off|auto|PxDxM",
+                   help="bench the rule-sharded build (partition-rule "
+                        "engine, parallel.sharding) instead of the plain "
+                        "jit; the resolved mesh shape and rule-table "
+                        "hash are recorded in the output JSON either "
+                        "way")
     p.add_argument("--async", dest="async_run", action="store_true",
                    help="bench the overlapped actor-learner engine "
                         "against the sync per-iteration loop on the same "
@@ -248,7 +254,16 @@ def main() -> None:
     if args.async_run:
         bench_async(cfg, args, platform, iters)
         return
-    exp = Experiment.build(cfg)
+    from rlgpuschedule_tpu.parallel import rule_table_hash, rules_for
+    from rlgpuschedule_tpu.train import make_run_mesh
+    run_mesh = make_run_mesh(args.mesh, cfg.n_envs)
+    exp = Experiment.build(cfg, mesh=run_mesh)
+    # layout provenance: two bench JSONs are throughput-comparable only
+    # when their layouts were (shape null = plain unsharded jit)
+    mesh_record = {
+        "shape": ({k: int(v) for k, v in run_mesh.shape.items()}
+                  if run_mesh is not None else None),
+        "rule_table_hash": rule_table_hash(rules_for(cfg))}
     n_chips = jax.device_count()
 
     def timed(k: int) -> float:
@@ -313,6 +328,7 @@ def main() -> None:
         # ISSUE-2 lever); the recorded baseline's geometry is 2x8
         "geometry": {"n_epochs": ppo.n_epochs, "n_minibatches": n_mb,
                      "minibatch_size": mb_size},
+        "mesh": mesh_record,
         "value": round(value, 1),
         "unit": "env-steps/s/chip",
         "vs_baseline": vs,

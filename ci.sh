@@ -151,6 +151,58 @@ print("serve smoke ok:", {"p50_ms": round(b["latency_p50_ms"], 3),
                           "fleet_mean_jct": round(fl["mean_jct"], 1)})
 EOF
 
+echo "=== smoke: sharding (rule-mesh train + PBT-on-mesh, 2 CPU devices) ==="
+# ISSUE 10 acceptance: a rule-sharded --mesh auto run and a PBT run
+# whose population rides the unified mesh's pop axis must both pass the
+# strict-alarms gate (zero post-warmup recompiles — the compile-once
+# contract of the rule-resolved in/out_shardings), and the train
+# summary must carry the mesh shape + rule-table hash provenance.
+MESH_OBS_DIR=$(mktemp -d /tmp/ci_mesh_obs.XXXXXX)
+PBT_OBS_DIR=$(mktemp -d /tmp/ci_pbt_obs.XXXXXX)
+MESH_JSON=$(mktemp /tmp/ci_mesh.XXXXXX.json)
+PBT_JSON=$(mktemp /tmp/ci_pbt.XXXXXX.json)
+trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON" \
+    "$MESH_OBS_DIR" "$PBT_OBS_DIR" "$MESH_JSON" "$PBT_JSON"' EXIT
+# JAX_ENABLE_COMPILATION_CACHE=false on BOTH mesh trains: the persistent
+# compile cache flakily heap-corrupts (malloc_consolidate / segfault,
+# ~25% of runs) when it round-trips a MULTI-device SPMD executable on
+# the forced-multi-device CPU backend (jax 0.4.37; single-device
+# programs — every other stage here — are unaffected). The mesh smokes
+# recompile from scratch each run; ~2 min extra, deterministic green.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    JAX_ENABLE_COMPILATION_CACHE=false \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python -m rlgpuschedule_tpu.train --config ppo-mlp-synth64 \
+    --mesh auto \
+    --iterations 3 --n-envs 4 --n-nodes 2 --gpus-per-node 4 \
+    --window-jobs 16 --horizon 64 --queue-len 4 --n-steps 8 \
+    --n-epochs 1 --n-minibatches 2 --log-every 1 \
+    --obs-dir "$MESH_OBS_DIR" --alarms > "$MESH_JSON"
+timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.obs.report "$MESH_OBS_DIR" --strict-alarms
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    JAX_ENABLE_COMPILATION_CACHE=false \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python -m rlgpuschedule_tpu.train --config ppo-mlp-synth64 \
+    --pbt --n-pop 2 --pbt-ready 1 \
+    --iterations 3 --n-envs 4 --n-nodes 2 --gpus-per-node 4 \
+    --window-jobs 16 --horizon 64 --queue-len 4 --n-steps 8 \
+    --n-epochs 1 --n-minibatches 2 --log-every 1 \
+    --obs-dir "$PBT_OBS_DIR" --alarms > "$PBT_JSON"
+timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.obs.report "$PBT_OBS_DIR" --strict-alarms
+python - "$MESH_JSON" "$PBT_JSON" <<'EOF'
+import json, sys
+mesh = json.load(open(sys.argv[1]))["mesh"]
+assert mesh["shape"] == {"pop": 1, "data": 2, "model": 1}, mesh
+assert len(mesh["rule_table_hash"]) == 12, mesh
+pbt = json.load(open(sys.argv[2]))["mesh"]
+assert pbt["shape"] == {"pop": 2, "data": 1, "model": 1}, pbt
+assert pbt["rule_table_hash"] == mesh["rule_table_hash"], (mesh, pbt)
+print("sharding smoke ok:", {"mesh": mesh["shape"], "pbt": pbt["shape"],
+                             "rules": mesh["rule_table_hash"]})
+EOF
+
 echo "=== tier-1 pytest gate 1/2: main pass (ROADMAP.md, minus spawn) ==="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

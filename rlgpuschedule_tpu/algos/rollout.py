@@ -114,5 +114,12 @@ def rollout(apply_fn: PolicyApply, net_params, env_params: EnvParams,
         return RolloutCarry(env_state, ts.obs, ts.action_mask, key), t
 
     carry, transitions = jax.lax.scan(step, carry, None, length=n_steps)
+    # Pin the trajectory stack's env axis to the mesh's data axis before it
+    # feeds GAE + the minibatch update: without the constraint GSPMD is free
+    # to replicate the [T, E, ...] buffer on every device, which is exactly
+    # the memory ceiling the partition-rule mesh exists to lift. Identity
+    # when no mesh is bound (single-device / legacy dp paths).
+    from ..parallel.sharding import DATA_AXIS, constrain_tree
+    transitions = constrain_tree(transitions, None, DATA_AXIS)
     _, last_value = apply_fn(net_params, carry.obs, carry.mask)
     return carry, transitions, last_value

@@ -18,10 +18,11 @@ class Rule:
 
 def all_rules() -> list[Rule]:
     from . import (donation, host_sync, impure_in_jit, prng_reuse,
-                   recompile, sync_in_loop, tracer_leak)
+                   recompile, sync_in_loop, tracer_leak,
+                   unconstrained_intermediate)
     return [donation.RULE, host_sync.RULE, sync_in_loop.RULE,
             tracer_leak.RULE, impure_in_jit.RULE, recompile.RULE,
-            prng_reuse.RULE]
+            prng_reuse.RULE, unconstrained_intermediate.RULE]
 
 
 def rule_names() -> list[str]:

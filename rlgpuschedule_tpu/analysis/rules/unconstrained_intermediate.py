@@ -1,0 +1,156 @@
+"""unconstrained-intermediate: mesh-era batch building with no layout pin.
+
+Under a mesh, GSPMD picks the layout of every intermediate the program
+does not pin. For the big batch-shaped builders — ``jnp.stack`` /
+``concatenate`` / ``tile`` / ``repeat`` / ``broadcast_to`` — the
+unconstrained choice is frequently full replication (or a gather back
+to one shard), which silently multiplies memory by the mesh size and
+inserts all-to-all traffic right where the program is widest. The
+partition-rule engine's discipline (``parallel.sharding``) is that
+trajectory-shaped intermediates get an explicit
+``with_sharding_constraint`` (or the repo's ``constrain`` /
+``constrain_tree`` wrappers) naming the data axis.
+
+It fires INSIDE traced regions, and only in modules with mesh evidence
+— a ``Mesh`` / ``make_mesh`` / ``make_unified_mesh`` / ``unified_mesh``
+/ ``NamedSharding`` construction, or a ``jax.jit`` call passing
+``in_shardings``/``out_shardings`` — so single-device code (tests,
+host utilities) never pays the rule. A builder result that flows
+through a constrainer in the same function, or is built directly
+inside a constrainer call, is pinned and never flagged: the fix for a
+finding is also its silencer.
+
+A deliberately replicated intermediate is a one-line suppression with
+the reason inline::
+
+    table = jnp.tile(base, (n, 1))  # jsan: disable=unconstrained-intermediate -- small lookup table, replication intended
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+from ..engine import Finding, ModuleContext, SourceFile
+
+# the module-level evidence that a mesh governs this code at all:
+# terminal names of mesh/sharding constructors (terminal so both
+# `jax.sharding.Mesh` and the repo's `parallel.mesh.make_unified_mesh`
+# count, however they were imported)
+_MESH_TERMINALS = {"Mesh", "make_mesh", "make_unified_mesh",
+                   "unified_mesh", "NamedSharding"}
+_JIT_CALLS = {"jax.jit", "jax.pmap", "equinox.filter_jit"}
+_SHARDING_KWARGS = {"in_shardings", "out_shardings"}
+
+# batch-shaped builders whose unconstrained GSPMD layout is the hazard
+_BUILDERS = {"jax.numpy.stack", "jax.numpy.concatenate",
+             "jax.numpy.tile", "jax.numpy.repeat",
+             "jax.numpy.broadcast_to"}
+
+# anything that pins a layout (terminal names: jax.lax.
+# with_sharding_constraint and the repo's parallel.sharding wrappers)
+_CONSTRAINERS = {"with_sharding_constraint", "constrain",
+                 "constrain_tree"}
+
+
+def _terminal_of(name: "str | None") -> "str | None":
+    return name.split(".")[-1] if name else None
+
+
+def _has_mesh_evidence(ctx: ModuleContext) -> bool:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.resolve_call(node)
+        if _terminal_of(name) in _MESH_TERMINALS:
+            return True
+        if name in _JIT_CALLS and any(kw.arg in _SHARDING_KWARGS
+                                      for kw in node.keywords):
+            return True
+    return False
+
+
+def _root_name(node: ast.AST) -> "str | None":
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _target_names(target: ast.AST) -> list[str]:
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for elt in target.elts:
+            out.extend(_target_names(elt))
+        return out
+    if isinstance(target, ast.Starred):
+        return _target_names(target.value)
+    name = _root_name(target)
+    return [name] if name else []
+
+
+def _constrained_names(fn: ast.AST, ctx: ModuleContext) -> set[str]:
+    """Names that pass through a constrainer anywhere in ``fn`` —
+    line-order is deliberately ignored (the reassignment idiom
+    ``x = constrain(x, ...)`` and pin-at-the-end both count)."""
+    pinned: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if _terminal_of(ctx.resolve_call(node)) not in _CONSTRAINERS:
+            continue
+        for arg in node.args:
+            name = _root_name(arg)
+            if name:
+                pinned.add(name)
+    return pinned
+
+
+def _inside_constrainer(ctx: ModuleContext, node: ast.AST) -> bool:
+    for parent in ctx.ancestors(node):
+        if isinstance(parent, ast.Call) \
+                and _terminal_of(ctx.resolve_call(parent)) \
+                in _CONSTRAINERS:
+            return True
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            return False
+    return False
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    if not _has_mesh_evidence(ctx):
+        return []
+    findings: list[Finding] = []
+    pinned_by_fn: dict[ast.AST, set[str]] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Call) \
+                or not ctx.in_traced_region(node):
+            continue
+        call = node.value
+        name = ctx.resolve_call(call)
+        if name not in _BUILDERS:
+            continue
+        if _inside_constrainer(ctx, call):
+            continue
+        fn = ctx.enclosing_function(node)
+        if fn not in pinned_by_fn:
+            pinned_by_fn[fn] = _constrained_names(fn, ctx)
+        targets = [t for tgt in node.targets
+                   for t in _target_names(tgt)]
+        if targets and all(t in pinned_by_fn[fn] for t in targets):
+            continue
+        findings.append(src.finding(
+            node, RULE.name,
+            f"{name}() builds a batch-shaped intermediate in traced "
+            f"code under a mesh without a sharding constraint — GSPMD "
+            f"is free to replicate it (memory x mesh size) or gather "
+            f"it to one shard; pin it with with_sharding_constraint / "
+            f"parallel.sharding.constrain, or suppress with the reason "
+            f"replication is intended"))
+    return findings
+
+
+RULE = Rule(
+    name="unconstrained-intermediate",
+    summary="mesh-traced batch builders with no sharding constraint",
+    check=_check)

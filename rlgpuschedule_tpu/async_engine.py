@@ -88,8 +88,9 @@ from .algos.ppo import make_learn_step as make_ppo_learn_step
 from .algos.rollout import make_rollout_step
 from .analysis.sentinels import no_implicit_transfers
 from .obs.telemetry import AsyncGauges, OverlapMeter
-from .parallel.dp import put_carry, put_global
-from .parallel.groups import DeviceGroups, split_devices
+from .parallel.dp import put_carry
+from .parallel.groups import DeviceGroups
+from .parallel.sharding import put_global
 from .utils.profiling import SectionTimer
 
 # every blocking wait re-checks abort/progress at this period, and gives
@@ -254,7 +255,13 @@ class AsyncRunner:
                              f"{staleness_bound}")
         cfg = exp.cfg
         algo_cfg = cfg.ppo if cfg.algo == "ppo" else cfg.a2c
-        groups = groups if groups is not None else split_devices()
+        if groups is None:
+            # default split carved from the shared unified mesh (same
+            # device walk as every other entry point), so actor/learner
+            # groups are submeshes of the ONE Mesh(pop × data × model)
+            from .parallel.groups import split_mesh
+            from .parallel.mesh import unified_mesh
+            groups = split_mesh(unified_mesh())
         # decoupled per-phase geometry validation: each phase against
         # ITS device group (the whole point of splitting the check)
         validate_rollout_geometry(algo_cfg.n_steps, cfg.n_envs,

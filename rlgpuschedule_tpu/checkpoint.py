@@ -22,8 +22,9 @@ Elastic recovery (shrink-to-fit): :meth:`Checkpointer.elastic_restore`
 restores a checkpoint written at world size N onto a SMALLER surviving
 topology — replicated state (params, optimizer moments) is world-size
 independent and restores unchanged; env-batched ``extra`` payloads (the
-rollout carry) keep only the surviving data shards' row blocks
-(``parallel.dp.shrink_env_rows``); and the update geometry is
+rollout carry) keep only the surviving data shards' row blocks, decided
+per-leaf by the partition-rule table
+(``parallel.sharding.shrink_env_rows_by_rule``); and the update geometry is
 re-validated against the shrunk global batch up front
 (:func:`validate_shrunk_geometry`), so an untileable shrink fails with
 a clear error instead of a shape error mid-step.
@@ -367,10 +368,14 @@ class Checkpointer:
           size independent, restored unchanged (template-FREE restore:
           the saved shapes are authoritative, not a template built at
           either world size).
-        - env-batched ``extra`` leaves (leading dim ``old_n_envs``,
-          inferred from the first extra leaf when not given) keep only
-          ``surviving_ranks``' contiguous row blocks
-          (``parallel.dp.shrink_env_rows``).
+        - env-batched ``extra`` leaves keep only ``surviving_ranks``'
+          contiguous row blocks. Which leaves are env-batched is decided
+          by the partition-rule table
+          (``parallel.sharding.ELASTIC_EXTRA_RULES``): leaves on the data
+          axis with leading dim ``old_n_envs`` (inferred from the first
+          extra leaf when not given) are sliced; rule-replicated leaves
+          — PRNG keys, matched by NAME — pass through whole even when
+          their length collides with ``old_n_envs``.
         - ``geometry`` = ``(n_epochs, n_minibatches, minibatch_size,
           n_steps)``, when given, is re-validated against the shrunk
           global batch via :func:`validate_shrunk_geometry` — the
@@ -400,7 +405,7 @@ class Checkpointer:
         # donation hazard `_fresh_copy` exists for) and frees the state
         # from the dead world's layout; a restart path can afford it.
         tree = jax.tree.map(np.asarray, restored["state"])
-        from rlgpuschedule_tpu.parallel import dp
+        from rlgpuschedule_tpu.parallel import sharding as shardlib
         extra = tree.get("extra")
         new_n_envs = None
         leaves = jax.tree.leaves(extra) if extra is not None else []
@@ -417,8 +422,9 @@ class Checkpointer:
                 n_epochs, n_mb, mb_size, n_steps = geometry
                 validate_shrunk_geometry(n_epochs, n_mb, mb_size, n_steps,
                                          new_n_envs, old_n_envs)
-            extra = dp.shrink_env_rows(
-                extra, old_n_envs=old_n_envs, old_world=old_world,
+            extra = shardlib.shrink_env_rows_by_rule(
+                extra, shardlib.ELASTIC_EXTRA_RULES,
+                old_n_envs=old_n_envs, old_world=old_world,
                 surviving_ranks=surv)
         rep = getattr(template_state, "replace", None) or \
             template_state._replace
@@ -432,7 +438,7 @@ class Checkpointer:
                 raise ElasticRestoreError(
                     f"shrunk env batch {new_n_envs} not divisible by the "
                     f"surviving mesh's data axis ({n_data})")
-            state = dp.put_global(state, replicated(mesh))
+            state = shardlib.put_global(state, replicated(mesh))
         self._emit("ckpt_elastic_restore", step=self.last_restored_step,
                    old_world=old_world, surviving_ranks=surv,
                    new_n_envs=new_n_envs)

@@ -146,6 +146,95 @@ HIER_PBT_MEMBER = _register(ExperimentConfig(
     window_jobs=64))
 
 
+class ModeCombinationError(ValueError):
+    """Two requested run modes are mutually unsupported (the single
+    refusal format `train` reports — see :data:`MODE_REFUSALS`)."""
+
+
+# How each mode name is spelled to the user in refusal messages.
+MODE_FLAGS: dict[str, str] = {
+    "async": "--async",
+    "pbt": "--pbt",
+    "faults": "--faults",
+    "fault_injection": "--fault",
+    "fused_chunk": "--fused-chunk",
+    "rollbacks": "--max-rollbacks",
+    "hier": "hierarchical config (n_pods > 1)",
+    "shard_map": "shard_map/axis_name build",
+    "mesh": "--mesh",
+}
+
+# THE mode-combination refusal matrix — every pairwise refusal `train`
+# (or a programmatic caller) enforces, in one place with one error
+# format, instead of the per-flag sys.exit checks that used to be
+# scattered through train.main. Order within a pair is cosmetic; the
+# check is symmetric. Each entry: (mode_a, mode_b, why-it-refuses).
+MODE_REFUSALS: tuple[tuple[str, str, str], ...] = (
+    ("async", "pbt",
+     "the PBT loop interleaves host-side exploit/explore between steps"),
+    ("async", "fused_chunk",
+     "the async engine already overlaps phases — pick one"),
+    ("async", "rollbacks",
+     "the divergence watchdog is sync-path-only for now"),
+    ("async", "fault_injection",
+     "fault injection hooks the sync loop's iteration boundary"),
+    ("async", "mesh",
+     "the async engine resolves its own actor/learner submeshes from "
+     "the unified mesh"),
+    ("pbt", "faults",
+     "the population step does not thread fault schedules"),
+    ("pbt", "fused_chunk",
+     "the PBT loop interleaves host-side exploit/explore between steps"),
+    ("pbt", "mesh",
+     "--pbt builds the population mesh from the unified mesh "
+     "automatically"),
+    ("hier", "faults",
+     "sim faults thread per-node health through flat observations only"),
+    ("shard_map", "pbt",
+     "the population step is a GSPMD vmap, not an axis-name program"),
+    ("shard_map", "async",
+     "the async engine jits per-group GSPMD programs, not shard_map"),
+    ("shard_map", "fused_chunk",
+     "run_fused jits the raw step; an axis-name step needs "
+     "dp.shard_map_train"),
+    ("shard_map", "mesh",
+     "rule-table shardings are GSPMD in/out_shardings; the axis-name "
+     "path wires its own specs in dp.shard_map_train"),
+)
+
+
+def _validate_refusal_table() -> None:
+    """The table is validated at import: a typo'd mode name would
+    otherwise silently never refuse anything."""
+    for a, b, why in MODE_REFUSALS:
+        for m in (a, b):
+            if m not in MODE_FLAGS:
+                raise AssertionError(
+                    f"MODE_REFUSALS names unknown mode {m!r} (known: "
+                    f"{sorted(MODE_FLAGS)})")
+        if a == b or not why:
+            raise AssertionError(f"malformed refusal entry {(a, b, why)!r}")
+
+
+_validate_refusal_table()
+
+
+def validate_mode_combination(active: dict[str, bool]) -> None:
+    """Raise :class:`ModeCombinationError` if any two ACTIVE modes are a
+    refused pair. ``active`` maps mode names (:data:`MODE_FLAGS` keys) to
+    whether the run requests them; unknown names raise (fail-loud — a
+    misspelled key would otherwise never be checked)."""
+    unknown = set(active) - set(MODE_FLAGS)
+    if unknown:
+        raise KeyError(f"unknown mode name(s) {sorted(unknown)}; known: "
+                       f"{sorted(MODE_FLAGS)}")
+    for a, b, why in MODE_REFUSALS:
+        if active.get(a) and active.get(b):
+            raise ModeCombinationError(
+                f"unsupported mode combination: {MODE_FLAGS[a]} × "
+                f"{MODE_FLAGS[b]} — {why}")
+
+
 def repro_tuple(cfg: ExperimentConfig, ckpt_dir: str | None = None,
                 ckpt_step: int | None = None) -> dict:
     """The reproducibility tuple every evaluate/serve JSON carries: the

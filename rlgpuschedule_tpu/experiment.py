@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 from .algos import (init_carry, make_a2c_step, make_ppo_step,
                     make_train_state, resolve_geometry)
+from .algos.rollout import RolloutCarry
 from .algos.ppo import make_optimizer
 from .configs import ExperimentConfig
 from .env import EnvParams, build_adjacency, stack_traces
@@ -211,10 +212,13 @@ class Experiment:
     # jitted step as an argument, never closed over, so schedules can
     # change without recompiling
     faults: Any = None
+    # unified Mesh(pop × data × model) the step was rule-sharded against
+    # (parallel.sharding), or None = plain single-program jit
+    mesh: Any = None
 
     @staticmethod
     def build(cfg: ExperimentConfig, axis_name: str | None = None,
-              jit: bool = True) -> "Experiment":
+              jit: bool = True, mesh=None) -> "Experiment":
         env_params, windows, traces, net, apply_fn, extra, source = \
             build_stack(cfg)
         faults = None
@@ -255,16 +259,53 @@ class Experiment:
                     "axis_name requires jit=False: hand the returned "
                     "train_step to parallel.dp.shard_map_train, which "
                     "wraps it in shard_map over the mesh axis")
-            # state and carry are replaced every iteration in run(), so
-            # donating them halves live copies in the benchmarked hot loop
-            jit_step = jax.jit(step_fn, donate_argnums=(0, 1))
+            if mesh is not None:
+                # rule-sharded single program: params/opt-state laid out
+                # by the model family's partition-rule table, env batch
+                # over data, and the step traced with the mesh bound so
+                # rollout's with_sharding_constraint pins the trajectory
+                from .parallel import sharding as shardlib
+                from .parallel.dp import carry_sharding_prefix
+                from .parallel.mesh import (DATA_AXIS, env_sharded,
+                                            replicated)
+                if cfg.n_envs % mesh.shape[DATA_AXIS]:
+                    raise ValueError(
+                        f"n_envs={cfg.n_envs} not divisible by the mesh's "
+                        f"data axis size {mesh.shape[DATA_AXIS]}")
+                rules = shardlib.rules_for(cfg)
+                state_sh = shardlib.tree_shardings(train_state, rules, mesh)
+                env = env_sharded(mesh)
+                rep = replicated(mesh)
+                carry_sh = carry_sharding_prefix(mesh)
+                jit_step = jax.jit(
+                    shardlib.bind_mesh(step_fn, mesh),
+                    in_shardings=(state_sh, carry_sh, env, rep, env),
+                    out_shardings=(state_sh, carry_sh, rep),
+                    donate_argnums=(0, 1))
+                train_state = shardlib.put_tree(train_state, state_sh)
+                carry = RolloutCarry(
+                    env_state=shardlib.put_global(carry.env_state, env),
+                    obs=shardlib.put_global(carry.obs, env),
+                    mask=shardlib.put_global(carry.mask, env),
+                    key=shardlib.put_global(carry.key, rep))
+                traces = shardlib.put_global(traces, env)
+                if faults is not None:
+                    faults = shardlib.put_global(faults, env)
+            else:
+                # state and carry are replaced every iteration in run(),
+                # so donating them halves live copies in the benchmarked
+                # hot loop
+                jit_step = jax.jit(step_fn, donate_argnums=(0, 1))
         else:
+            if mesh is not None:
+                raise ValueError("mesh requires jit=True (the rule-table "
+                                 "shardings are jit in/out_shardings)")
             jit_step = step_fn
         return Experiment(cfg=cfg, env_params=env_params, windows=windows,
                           traces=traces, net=net, apply_fn=apply_fn,
                           train_state=train_state, train_step=jit_step,
                           carry=carry, key=key, source=source,
-                          train_step_raw=step_fn, faults=faults)
+                          train_step_raw=step_fn, faults=faults, mesh=mesh)
 
     @property
     def steps_per_iteration(self) -> int:
@@ -496,6 +537,16 @@ class Experiment:
         if watchdog is not None and ckpt.latest_step() is None:
             # guarantee a rollback target before the first periodic save
             self.save_checkpoint(ckpt, meta={"iteration": -1})
+        # under a mesh build the step pins its key argument to the
+        # replicated sharding; a freshly split subkey is committed to the
+        # default device, so the jit would replicate it with an implicit
+        # device-to-device copy INSIDE the guarded dispatch (a transfer
+        # alarm). Place it explicitly here, outside the guard, like every
+        # other input placed at build time.
+        key_rep = None
+        if self.mesh is not None:
+            from .parallel.mesh import replicated
+            key_rep = replicated(self.mesh)
         i = 0
         while i < iterations:
             # hooks see the chunk's last iteration (== i when unchunked);
@@ -514,6 +565,8 @@ class Experiment:
                     metrics = self.run_fused(fused_chunk)
             else:
                 self.key, sub = jax.random.split(self.key)
+                if key_rep is not None:
+                    sub = jax.device_put(sub, key_rep)
                 with sections("step"), guard:
                     self.train_state, self.carry, metrics = self.train_step(
                         self.train_state, self.carry, self.traces, sub,
@@ -643,6 +696,9 @@ class PopulationExperiment:
     pop_step: Callable       # jitted
     controller: Any          # PBTController
     windows: list = None     # host ArrayTrace windows (shared; eval reuse)
+    mesh: Any = None         # unified Mesh when members ride the pop axis
+    state_sharding: Any = None    # rule-resolved member-stack layout
+    hparam_sharding: Any = None   # [P] hparam layout (pop axis)
 
     @staticmethod
     def build(cfg: ExperimentConfig, n_pop: int = 4, mesh=None,
@@ -694,16 +750,30 @@ class PopulationExperiment:
             if cfg.n_envs % mesh.shape["data"] != 0:
                 raise ValueError(f"n_envs={cfg.n_envs} not divisible by "
                                  f"data axis size {mesh.shape['data']}")
-            jitted = jit_population_step(mesh, pop_step)
+            # member-state layout resolved per-leaf from the same
+            # partition-rule table the single-run path uses: pop axis on
+            # the member stack, model axis on kernels within each member
+            from .parallel import sharding as shardlib
             from .parallel.population import population_shardings
-            st_sh, ca_sh, tr_sh, key_sh, hp_sh = population_shardings(mesh)
+            rules = shardlib.rules_for(cfg)
+            jitted = jit_population_step(mesh, pop_step, states=states,
+                                         rules=rules)
+            st_sh, ca_sh, tr_sh, key_sh, hp_sh = population_shardings(
+                mesh, states=states, rules=rules)
             states = jax.device_put(states, st_sh)
             stacked_carries = jax.device_put(stacked_carries, ca_sh)
             traces = jax.device_put(traces, tr_sh)
             keys = jax.device_put(keys, key_sh)
             hparams = jax.device_put(hparams, hp_sh)
-        else:
-            jitted = jax.jit(pop_step, donate_argnums=(0, 1))
+            return PopulationExperiment(
+                cfg=cfg, n_pop=n_pop, env_params=env_params,
+                traces=traces, apply_fn=apply_fn, states=states,
+                carries=stacked_carries, hparams=hparams, keys=keys,
+                pop_step=jitted,
+                controller=PBTController(n_pop, pbt_cfg),
+                windows=windows, mesh=mesh, state_sharding=st_sh,
+                hparam_sharding=hp_sh)
+        jitted = jax.jit(pop_step, donate_argnums=(0, 1))
         return PopulationExperiment(
             cfg=cfg, n_pop=n_pop, env_params=env_params, traces=traces,
             apply_fn=apply_fn, states=states, carries=stacked_carries,
@@ -863,6 +933,16 @@ class PopulationExperiment:
             out = self.controller.maybe_update(i, self.states, self.hparams)
             if out is not None:
                 self.states, self.hparams, decision = out
+                if self.mesh is not None:
+                    # the exploit gather + host-side explore hand back
+                    # arrays without the pop-axis commitment; re-pin them
+                    # HERE — outside the next dispatch's transfer guard —
+                    # or the jit replicates them with an implicit
+                    # device-to-device copy (transfer alarm)
+                    self.states = jax.device_put(self.states,
+                                                 self.state_sharding)
+                    self.hparams = jax.device_put(self.hparams,
+                                                  self.hparam_sharding)
                 if telemetry is not None:
                     telemetry.emit(
                         "pbt_exploit", iteration=i,

@@ -1,25 +1,38 @@
-"""L5 distributed execution: device mesh, data-parallel training,
-population-based training (PBT)."""
-from .mesh import (make_mesh, replicated, env_sharded, pop_sharded,
-                   pop_env_sharded, DATA_AXIS, POP_AXIS)
+"""L5 distributed execution: device mesh, partition-rule sharding engine,
+data-parallel training, population-based training (PBT)."""
+from .mesh import (make_mesh, make_unified_mesh, unified_mesh, replicated,
+                   env_sharded, pop_sharded, pop_env_sharded, DATA_AXIS,
+                   POP_AXIS, MODEL_AXIS)
+from .sharding import (match_partition_rules, match_rule, named_tree_map,
+                       tree_shardings, make_shard_and_gather_fns,
+                       put_global, put_tree, rules_for, rule_table_hash,
+                       RULE_TABLES, constrain, constrain_tree, bind_mesh,
+                       use_mesh, active_mesh, shrink_env_rows_by_rule,
+                       ELASTIC_EXTRA_RULES)
 from .dp import (shard_train, shard_map_train, carry_sharding_prefix,
                  put_carry)
-from .groups import DeviceGroups, split_devices, parse_group_spec
+from .groups import DeviceGroups, split_devices, split_mesh, parse_group_spec
 from .population import (HParams, MemberState, init_member,
                          make_member_step, make_population_step,
                          jit_population_step, population_shardings,
-                         sample_hparams, stack_members)
+                         member_stack_specs, sample_hparams, stack_members)
 from .pbt import (PBTConfig, PBTController, PBTDecision, exploit_explore,
                   gather_members, HPARAM_BOUNDS)
 
 __all__ = [
-    "make_mesh", "replicated", "env_sharded", "pop_sharded",
-    "pop_env_sharded", "DATA_AXIS", "POP_AXIS",
+    "make_mesh", "make_unified_mesh", "unified_mesh", "replicated",
+    "env_sharded", "pop_sharded", "pop_env_sharded", "DATA_AXIS",
+    "POP_AXIS", "MODEL_AXIS",
+    "match_partition_rules", "match_rule", "named_tree_map",
+    "tree_shardings", "make_shard_and_gather_fns", "put_global",
+    "put_tree", "rules_for", "rule_table_hash", "RULE_TABLES",
+    "constrain", "constrain_tree", "bind_mesh", "use_mesh", "active_mesh",
+    "shrink_env_rows_by_rule", "ELASTIC_EXTRA_RULES",
     "shard_train", "shard_map_train", "carry_sharding_prefix", "put_carry",
-    "DeviceGroups", "split_devices", "parse_group_spec",
+    "DeviceGroups", "split_devices", "split_mesh", "parse_group_spec",
     "HParams", "MemberState", "init_member", "make_member_step",
     "make_population_step", "jit_population_step", "population_shardings",
-    "sample_hparams", "stack_members",
+    "member_stack_specs", "sample_hparams", "stack_members",
     "PBTConfig", "PBTController", "PBTDecision", "exploit_explore",
     "gather_members", "HPARAM_BOUNDS",
 ]

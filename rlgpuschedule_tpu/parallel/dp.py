@@ -32,7 +32,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..algos.rollout import RolloutCarry
-from .mesh import DATA_AXIS, data_shard_slices, env_sharded, replicated
+from .mesh import DATA_AXIS, env_sharded, replicated
+from .sharding import put_global as _put_global
+from .sharding import shrink_env_rows_by_rule as _shrink_by_rule
 
 
 def shard_map_compat(fn, mesh, in_specs, out_specs, check: bool = True):
@@ -51,27 +53,17 @@ def shard_map_compat(fn, mesh, in_specs, out_specs, check: bool = True):
 
 
 def put_global(tree: Any, sharding: NamedSharding) -> Any:
-    """``device_put`` every leaf of ``tree`` onto ``sharding``, including
-    in MULTI-CONTROLLER runs. Plain ``jax.device_put`` refuses a host
-    value destined for a sharding that spans non-addressable devices (the
-    multihost mesh — this is what killed the 2-process dryrun's ranks);
-    there each process instead contributes its addressable shards of its
-    local copy via ``jax.make_array_from_process_local_data``. Leaves
-    that are already global (non-fully-addressable) jax.Arrays — e.g.
-    traces assembled by ``multihost.global_traces`` — are passed through
-    untouched, since their shards cannot be re-placed host-side."""
-    import numpy as np
+    """DEPRECATED shim: the implementation moved to
+    ``parallel.sharding.put_global`` (the rule engine owns placement).
+    Delegates and warns; external callers keep working for one
+    release."""
+    import warnings
 
-    def put(x):
-        if isinstance(x, jax.Array) and not x.is_fully_addressable:
-            return x
-        if sharding.is_fully_addressable:
-            return jax.device_put(x, sharding)
-        arr = np.asarray(x)
-        return jax.make_array_from_process_local_data(
-            sharding, arr, arr.shape)
-
-    return jax.tree.map(put, tree)
+    warnings.warn(
+        "parallel.dp.put_global is deprecated; use "
+        "parallel.sharding.put_global",
+        DeprecationWarning, stacklevel=2)
+    return _put_global(tree, sharding)
 
 
 def carry_sharding_prefix(mesh: Mesh) -> RolloutCarry:
@@ -89,45 +81,32 @@ def put_carry(mesh: Mesh, carry: RolloutCarry,
     shard_map path stacks per-shard keys over ``data``)."""
     env = env_sharded(mesh)
     return RolloutCarry(
-        env_state=put_global(carry.env_state, env),
-        obs=put_global(carry.obs, env),
-        mask=put_global(carry.mask, env),
-        key=put_global(carry.key, key_sharding or replicated(mesh)))
+        env_state=_put_global(carry.env_state, env),
+        obs=_put_global(carry.obs, env),
+        mask=_put_global(carry.mask, env),
+        key=_put_global(carry.key, key_sharding or replicated(mesh)))
 
 
 def shrink_env_rows(tree: Any, *, old_n_envs: int, old_world: int,
                     surviving_ranks) -> Any:
-    """Shrink-to-fit an env-batched pytree to the surviving data shards:
-    every leaf whose leading dim is ``old_n_envs`` keeps ONLY the row
-    blocks that lived on ``surviving_ranks`` (contiguous per-shard blocks
-    under ``env_sharded``'s layout — ``mesh.data_shard_slices``); leaves
-    with any other leading dim (replicated params, PRNG keys, scalars)
-    pass through untouched. Host-side numpy op: the shrunk tree is
-    re-placed on the new mesh by the caller (``put_global``/``put_carry``
-    accept any world size — that is the elastic contract).
+    """DEPRECATED shim: elastic shrink-to-fit moved to
+    ``parallel.sharding.shrink_env_rows_by_rule``, which decides per-leaf
+    by partition RULE instead of this shim's leading-dim heuristic (the
+    documented key-length collision caveat is fixed there by keying PRNG
+    keys by name). The shim reproduces the old dim-keyed behavior
+    exactly — every leaf treated as data-axis-resident, sliced iff its
+    leading dim equals ``old_n_envs`` — and warns."""
+    import warnings
 
-    Caveat: "env-batched" is recognized by leading-dim equality, so an
-    ``old_n_envs`` that collides with an unrelated leaf's leading dim
-    (e.g. 2, a raw PRNG key's length) would mis-slice it — callers keep
-    key leaves out of the tree or use batches > 2 (every real config
-    does)."""
-    import numpy as np
+    from jax.sharding import PartitionSpec
 
-    surv = sorted(set(int(r) for r in surviving_ranks))
-    if not surv:
-        raise ValueError("shrink_env_rows: no surviving ranks")
-    if surv[0] < 0 or surv[-1] >= old_world:
-        raise ValueError(f"surviving_ranks {surv} outside the saved world "
-                         f"range [0, {old_world})")
-    slices = data_shard_slices(old_n_envs, old_world)
-
-    def shrink(x):
-        arr = np.asarray(x)
-        if arr.ndim >= 1 and arr.shape[0] == old_n_envs:
-            return np.concatenate([arr[slices[r]] for r in surv], axis=0)
-        return arr
-
-    return jax.tree.map(shrink, tree)
+    warnings.warn(
+        "parallel.dp.shrink_env_rows is deprecated; use "
+        "parallel.sharding.shrink_env_rows_by_rule with a rule table",
+        DeprecationWarning, stacklevel=2)
+    return _shrink_by_rule(tree, [(r".*", PartitionSpec(DATA_AXIS))],
+                           old_n_envs=old_n_envs, old_world=old_world,
+                           surviving_ranks=surviving_ranks)
 
 
 def _check_env_divisible(mesh: Mesh, traces) -> None:
@@ -153,9 +132,9 @@ def shard_train(mesh: Mesh, train_step: Callable, train_state, carry,
                      out_shardings=(rep, carry_sh, rep),
                      donate_argnums=(0, 1))
     return (jitted,
-            put_global(train_state, rep),
+            _put_global(train_state, rep),
             put_carry(mesh, carry),
-            put_global(traces, env))
+            _put_global(traces, env))
 
 
 def shard_map_train(mesh: Mesh, train_step_axis: Callable, train_state,

@@ -156,3 +156,16 @@ def split_devices(actor: str | int | list | None = None,
         actor_mesh=Mesh(np.asarray(a), (DATA_AXIS,)),
         learner_mesh=Mesh(np.asarray(l), (DATA_AXIS,)),
         shared=shared)
+
+
+def split_mesh(mesh: Mesh, actor: str | int | list | None = None,
+               learner: str | int | list | None = None) -> DeviceGroups:
+    """Carve the actor/learner groups out of the UNIFIED mesh's device
+    set (``mesh.make_unified_mesh``) instead of the raw local device
+    list — the groups become submeshes of the one mesh every other entry
+    point shares, so a deployment that pins the unified mesh to a subset
+    of the rig automatically scopes the async split to the same subset.
+    Devices walk the mesh in (pop, data, model) raster order, so the
+    default first-half/second-half split cuts along the data axis."""
+    return split_devices(actor, learner,
+                         devices=list(mesh.devices.flatten()))

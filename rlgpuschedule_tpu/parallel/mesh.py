@@ -10,6 +10,14 @@ a slice (SURVEY.md §5 "Distributed communication backend"). Axes:
   laid out on the *outer* mesh dim so cross-member traffic (rare:
   exploit/explore weight copies) maps to the slower links and the
   per-step gradient psum stays on the inner, fastest ICI loop.
+- ``model``: parameter/optimizer sharding for encoders that outgrow one
+  chip (the partition-rule tables in ``parallel.sharding`` name this
+  axis); innermost so the per-matmul allreduce rides the fastest links.
+
+:func:`make_mesh` (2-axis, legacy) is kept for the hand-wired dp path;
+:func:`make_unified_mesh` is the ONE ``Mesh(pop × data × model)`` every
+entry point — train, PBT, async groups, serve — now resolves placements
+from.
 """
 from __future__ import annotations
 
@@ -20,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 POP_AXIS = "pop"
+MODEL_AXIS = "model"
 
 
 def make_mesh(n_devices: int | None = None, n_pop: int = 1,
@@ -34,6 +43,43 @@ def make_mesh(n_devices: int | None = None, n_pop: int = 1,
         raise ValueError(f"{n} devices not divisible by n_pop={n_pop}")
     arr = np.asarray(devices).reshape(n_pop, n // n_pop)
     return Mesh(arr, (POP_AXIS, DATA_AXIS))
+
+
+def make_unified_mesh(n_pop: int = 1, n_model: int = 1,
+                      devices=None) -> Mesh:
+    """The shared 3-axis ``Mesh(pop × data × model)``. ``n_pop`` and
+    ``n_model`` must tile the device count; the data axis absorbs the
+    rest. Axis order is (pop, data, model): population traffic (rare) on
+    the outer/slowest links, the model axis's per-matmul collectives on
+    the inner/fastest. Size-1 axes cost nothing — specs naming them
+    degrade to replication — so a plain DP run and a model-sharded run
+    share one mesh type and one rule table."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n_pop < 1 or n_model < 1:
+        raise ValueError(f"mesh axes must be >= 1, got n_pop={n_pop}, "
+                         f"n_model={n_model}")
+    if n % (n_pop * n_model) != 0:
+        raise ValueError(f"{n} devices not divisible by n_pop={n_pop} * "
+                         f"n_model={n_model}")
+    n_data = n // (n_pop * n_model)
+    arr = np.asarray(devices).reshape(n_pop, n_data, n_model)
+    return Mesh(arr, (POP_AXIS, DATA_AXIS, MODEL_AXIS))
+
+
+_UNIFIED_CACHE: dict[tuple, Mesh] = {}
+
+
+def unified_mesh(n_pop: int = 1, n_model: int = 1) -> Mesh:
+    """Process-wide cached :func:`make_unified_mesh` over ALL visible
+    devices — the "constructed once" mesh the entry points share. Cached
+    per axis shape so train, async groups, and serve resolving the same
+    geometry get the *same* Mesh object (submesh/device identity checks
+    stay cheap and exact)."""
+    key = (n_pop, n_model, jax.device_count())
+    if key not in _UNIFIED_CACHE:
+        _UNIFIED_CACHE[key] = make_unified_mesh(n_pop, n_model)
+    return _UNIFIED_CACHE[key]
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

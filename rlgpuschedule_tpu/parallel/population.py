@@ -124,24 +124,66 @@ def make_population_step(apply_fn: PolicyApply, env_params: EnvParams,
     return jax.vmap(member, in_axes=(0, 0, None, 0, 0))
 
 
-def population_shardings(mesh: Mesh):
+def member_stack_specs(stacked_states: MemberState, rules) -> Any:
+    """Per-leaf PartitionSpecs for a stacked ``[P, ...]`` member tree: the
+    member axis maps onto ``pop`` and each leaf's *within-member* layout
+    comes from the partition-rule table (``parallel.sharding``), matched
+    on the '/'-joined leaf path — so a CNN/GNN population shards its
+    kernels over ``model`` exactly like the single-run path does, one
+    rule table for both. Leaves with no within-member extent beyond the
+    stack axis (step counters, stacked scalars like Adam's ``count``) get
+    plain ``P(pop)``."""
+    from jax.sharding import PartitionSpec as P
+
+    from . import sharding as shardlib
+    from .mesh import POP_AXIS
+
+    def spec_for(name: str, leaf: Any) -> P:
+        if getattr(leaf, "ndim", np.ndim(leaf)) <= 1:
+            return P(POP_AXIS)
+        inner = shardlib.match_rule(rules, name)
+        return P(POP_AXIS, *inner)
+
+    return shardlib.named_tree_map(spec_for, stacked_states)
+
+
+def population_shardings(mesh: Mesh, states: MemberState | None = None,
+                         rules=None):
     """(member_state, carry, traces, keys, hps) shardings: member axis over
     ``pop``, env axis over ``data`` — gradients never cross members, so the
     only collective GSPMD inserts is the per-member env-batch reduction
     within a ``pop`` row. Traces carry no member axis (see
     make_population_step): env axis over ``data``, replicated over
-    ``pop``."""
+    ``pop``.
+
+    With ``states`` + ``rules`` given, the member-state sharding is
+    resolved per-leaf from the partition-rule table
+    (:func:`member_stack_specs`) instead of wholesale ``P(pop)`` — on a
+    model axis of size 1 the two are the same layout."""
+    from jax.sharding import NamedSharding
+
+    from . import sharding as shardlib
+
     pop = pop_sharded(mesh)
     pop_env = pop_env_sharded(mesh)
-    state = MemberState(params=pop, opt_state=pop, step=pop)
+    if states is not None and rules is not None:
+        specs = member_stack_specs(states, rules)
+        state = jax.tree.map(
+            lambda s: NamedSharding(mesh, shardlib.prune_spec(s, mesh)),
+            specs)
+    else:
+        state = MemberState(params=pop, opt_state=pop, step=pop)
     carry = RolloutCarry(env_state=pop_env, obs=pop_env, mask=pop_env,
                          key=pop)
     hp = HParams(lr=pop, ent_coef=pop, clip_eps=pop)
     return state, carry, env_sharded(mesh), pop, hp
 
 
-def jit_population_step(mesh: Mesh, pop_step: Callable) -> Callable:
-    state_sh, carry_sh, trace_sh, key_sh, hp_sh = population_shardings(mesh)
+def jit_population_step(mesh: Mesh, pop_step: Callable,
+                        states: MemberState | None = None,
+                        rules=None) -> Callable:
+    state_sh, carry_sh, trace_sh, key_sh, hp_sh = population_shardings(
+        mesh, states, rules)
     metrics_sh = jax.tree.map(lambda _: pop_sharded(mesh),
                               PPOMetrics(*[0.0] * len(PPOMetrics._fields)))
     return jax.jit(pop_step,

@@ -62,7 +62,16 @@ class InferenceEngine:
         self.strict = strict
         self.registry = registry if registry is not None else Registry()
         self._bus = bus
-        self._params = jax.device_put(net_params)
+        # placement resolved from the shared unified mesh (same device
+        # walk as train/async) instead of jax's implicit default device:
+        # the engine serves from a one-device submesh — the mesh's first
+        # device — so a deployment that pins the unified mesh to a chip
+        # subset moves serving with it. Multi-engine serving (one engine
+        # per mesh column + a router) is the named next layer (ROADMAP).
+        from ..parallel.mesh import unified_mesh
+        self._serve_sharding = jax.sharding.SingleDeviceSharding(
+            unified_mesh().devices.flatten()[0])
+        self._params = jax.device_put(net_params, self._serve_sharding)
         pre = (preempt_slice(env_params)
                if stall_gate and env_params is not None else None)
         thresh = stall_threshold(env_params) if pre is not None else 0
@@ -171,9 +180,10 @@ class InferenceEngine:
         stall_p = pad_batch(np.asarray(stall, np.int32), bucket)
         # explicit upload: the one host->device transfer serving performs,
         # outside the transfer-guarded dispatch by design
-        obs_d = jax.device_put(obs_p)
-        mask_d = jax.device_put(mask_p)
-        stall_d = jax.device_put(stall_p) if self._has_stall_gate else None
+        obs_d = jax.device_put(obs_p, self._serve_sharding)
+        mask_d = jax.device_put(mask_p, self._serve_sharding)
+        stall_d = (jax.device_put(stall_p, self._serve_sharding)
+                   if self._has_stall_gate else None)
         out = self._dispatch(obs_d, mask_d, stall_d, bucket)
         actions = jax.device_get(out)       # explicit download, ditto
         return jax.tree.map(lambda a: a[:n], actions), bucket

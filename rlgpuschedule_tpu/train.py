@@ -20,9 +20,11 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import re
 import sys
 
-from .configs import CONFIGS, ExperimentConfig
+from .configs import (CONFIGS, ExperimentConfig, ModeCombinationError,
+                      validate_mode_combination)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -128,6 +130,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--queue-capacity", type=int, default=2,
                    help="with --async: trajectory-queue slots; a full "
                         "queue blocks the actor (backpressure, no drops)")
+    p.add_argument("--mesh", default="off", metavar="off|auto|PxDxM",
+                   help="rule-table sharding for the single-run path: "
+                        "build the unified Mesh(pop x data x model) and "
+                        "jit the train step with in/out shardings "
+                        "resolved from the model family's partition-rule "
+                        "table (parallel.sharding). 'auto' picks the "
+                        "largest data axis dividing both n_envs and the "
+                        "device count (model axis 1 — bit-identical "
+                        "layout to replication); an explicit PxDxM "
+                        "triple (e.g. 1x2x2) also engages the model "
+                        "axis. 'off' (default) is the plain jit path")
     # population / PBT (config 5)
     p.add_argument("--pbt", action="store_true",
                    help="train a PBT population instead of a single run")
@@ -366,11 +379,13 @@ class FittestMemberView:
 
 
 def make_pop_mesh(n_pop: int):
-    """Best (pop, data) mesh for the available devices: the largest pop
-    axis that divides both the population and the device count (1 device →
-    no mesh)."""
+    """Best unified mesh for a population run: the largest pop axis that
+    divides both the population and the device count (1 device → no
+    mesh), remaining devices on the data axis, model axis free at 1.
+    Built through the SAME ``make_unified_mesh`` every other entry point
+    resolves placements from."""
     import jax
-    from .parallel import make_mesh
+    from .parallel import make_unified_mesh
     n_dev = jax.device_count()
     if n_dev == 1:
         return None
@@ -379,7 +394,40 @@ def make_pop_mesh(n_pop: int):
         if n_pop % c == 0 and n_dev % c == 0:
             pop_axis = c
             break
-    return make_mesh(devices=None, n_pop=pop_axis)
+    return make_unified_mesh(n_pop=pop_axis)
+
+
+def make_run_mesh(spec: str, n_envs: int):
+    """Resolve ``--mesh`` into a unified mesh (or None for the plain
+    path). ``auto`` puts the largest data axis that divides both the env
+    batch and the device count, model axis 1; an explicit ``PxDxM``
+    triple engages exactly P*D*M devices."""
+    import jax
+    from .parallel import make_unified_mesh
+    if spec == "off":
+        return None
+    devices = jax.devices()
+    if spec == "auto":
+        n_dev = len(devices)
+        data = 1
+        for c in range(min(n_envs, n_dev), 0, -1):
+            if n_envs % c == 0 and n_dev % c == 0:
+                data = c
+                break
+        if data == 1 and n_dev == 1:
+            return None
+        return make_unified_mesh(devices=devices[:data])
+    p, d, m = (int(x) for x in spec.split("x"))
+    if p * d * m == 0:
+        sys.exit(f"bad --mesh {spec!r}: every axis must be >= 1")
+    if p * d * m > len(devices):
+        sys.exit(f"--mesh {spec} asks for {p * d * m} devices but only "
+                 f"{len(devices)} are visible")
+    if n_envs % d:
+        sys.exit(f"--mesh {spec}: data axis {d} does not divide "
+                 f"n_envs={n_envs}")
+    return make_unified_mesh(n_pop=p, n_model=m,
+                             devices=devices[:p * d * m])
 
 
 def main(argv: list[str] | None = None) -> dict:
@@ -432,9 +480,10 @@ def main(argv: list[str] | None = None) -> dict:
         if args.faults not in FAULT_REGIMES:
             sys.exit(f"unknown --faults regime {args.faults!r}; known: "
                      f"{sorted(FAULT_REGIMES)}")
-        if args.pbt:
-            sys.exit("--faults applies to single-run configs (the "
-                     "population step does not thread fault schedules)")
+    if args.mesh != "off" and args.mesh != "auto" \
+            and not re.fullmatch(r"\d+x\d+x\d+", args.mesh):
+        sys.exit(f"bad --mesh {args.mesh!r}: expected off, auto, or an "
+                 f"explicit PxDxM axis triple like 1x2x1")
     if not args.async_run:
         for flag, val, default in (("--actor-devices",
                                     args.actor_devices, None),
@@ -448,21 +497,6 @@ def main(argv: list[str] | None = None) -> dict:
                 sys.exit(f"{flag} configures the async engine; pass "
                          f"--async with it (refusing the silent no-op)")
     else:
-        if args.pbt:
-            sys.exit("--async applies to single-run configs (the PBT "
-                     "loop interleaves host-side exploit/explore "
-                     "between steps)")
-        if args.fused_chunk > 1:
-            sys.exit("--fused-chunk fuses the SYNC loop's dispatches; "
-                     "the async engine already overlaps phases — pick "
-                     "one")
-        if args.max_rollbacks is not None:
-            sys.exit("--max-rollbacks (divergence watchdog) is "
-                     "sync-path-only for now; run --async without it")
-        if args.fault:
-            sys.exit("--fault injection hooks the sync loop's "
-                     "iteration boundary; it is not threaded through "
-                     "the async engine")
         if args.staleness_bound < 0:
             sys.exit("--staleness-bound must be >= 0")
         if args.queue_capacity < 1:
@@ -477,6 +511,22 @@ def main(argv: list[str] | None = None) -> dict:
         if args.alarm_slow_iter <= 0:
             sys.exit("--alarm-slow-iter must be positive")
     cfg = apply_overrides(CONFIGS[args.config], args)
+    # the ONE mode-combination gate: every pairwise refusal lives in
+    # configs.MODE_REFUSALS (one validated table, one error format)
+    # instead of per-flag checks scattered through this function
+    try:
+        validate_mode_combination({
+            "async": args.async_run,
+            "pbt": args.pbt,
+            "faults": args.faults is not None,
+            "fault_injection": bool(faults),
+            "fused_chunk": args.fused_chunk > 1,
+            "rollbacks": args.max_rollbacks is not None,
+            "hier": cfg.n_pods > 1,
+            "mesh": args.mesh != "off",
+        })
+    except ModeCombinationError as e:
+        sys.exit(str(e))
     if args.source_jobs is not None:
         if args.source_jobs <= 0:
             sys.exit("--source-jobs must be positive")
@@ -532,16 +582,23 @@ def main(argv: list[str] | None = None) -> dict:
         if ckpt is not None:
             stack.enter_context(ckpt)
 
+        run_mesh = None
         if args.pbt:
             from .experiment import PopulationExperiment
             from .parallel import PBTConfig
+            run_mesh = make_pop_mesh(args.n_pop)
             exp = PopulationExperiment.build(
-                cfg, n_pop=args.n_pop, mesh=make_pop_mesh(args.n_pop),
+                cfg, n_pop=args.n_pop, mesh=run_mesh,
                 pbt_cfg=PBTConfig(ready_iters=args.pbt_ready,
                                   seed=cfg.seed))
         else:
             from .experiment import Experiment
-            exp = Experiment.build(cfg)
+            run_mesh = make_run_mesh(args.mesh, cfg.n_envs)
+            exp = Experiment.build(cfg, mesh=run_mesh)
+        if run_mesh is not None:
+            from .parallel import rule_table_hash, rules_for
+            print(f"mesh: {dict(run_mesh.shape)} rules="
+                  f"{rule_table_hash(rules_for(cfg))}", file=sys.stderr)
         if args.resume:
             if ckpt is None:
                 sys.exit("--resume requires --ckpt-dir")
@@ -598,10 +655,6 @@ def main(argv: list[str] | None = None) -> dict:
 
         run_kw = {}
         if args.fused_chunk > 1:
-            if args.pbt:
-                sys.exit("--fused-chunk applies to single-run configs "
-                         "(the PBT loop interleaves host-side exploit/"
-                         "explore between steps)")
             run_kw["fused_chunk"] = args.fused_chunk
         if args.max_rollbacks is not None:
             from .resilience import DivergenceWatchdog
@@ -638,6 +691,11 @@ def main(argv: list[str] | None = None) -> dict:
             sys.exit(f"divergence watchdog gave up: {e}")
 
         summary = {k: v for k, v in out.items() if k != "history"}
+        if run_mesh is not None:
+            from .parallel import rule_table_hash, rules_for
+            summary["mesh"] = {
+                "shape": {k: int(v) for k, v in run_mesh.shape.items()},
+                "rule_table_hash": rule_table_hash(rules_for(cfg))}
         if args.report and not args.pbt and cfg.n_pods == 1:
             from .eval import format_report, jct_report
             report = jct_report(exp)

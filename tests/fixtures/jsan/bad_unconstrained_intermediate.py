@@ -1,0 +1,24 @@
+"""Known-bad: unconstrained batch builders in mesh-traced code (2
+findings)."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+mesh = Mesh(jax.devices(), ("data",))
+
+
+@jax.jit
+def fuse_batches(a, b, table):
+    batch = jnp.concatenate([a, b])          # finding: never constrained
+    tiled = jnp.tile(table, (batch.shape[0], 1))   # finding: ditto
+    return batch @ tiled.T
+
+
+def make_rollout_step(apply_fn):
+    def rollout_step(params, obs_list):
+        obs = jnp.stack(obs_list)            # pinned below — no finding
+        obs = jax.lax.with_sharding_constraint(
+            obs, NamedSharding(mesh, PartitionSpec("data")))
+        return apply_fn(params, obs)
+
+    return rollout_step

@@ -28,10 +28,13 @@ BAD = {
     "recompile-hazard": ("bad_recompile.py", 2),
     "prng-key-reuse": ("bad_prng_reuse.py", 3),
     "sync-in-loop": ("bad_sync_in_loop.py", 3),
+    "unconstrained-intermediate":
+        ("bad_unconstrained_intermediate.py", 2),
 }
 GOOD = ["good_donation.py", "good_host_sync.py", "good_tracer_leak.py",
         "good_impure.py", "good_recompile.py", "good_prng_reuse.py",
-        "good_sync_in_loop.py"]
+        "good_sync_in_loop.py",
+        "good_unconstrained_intermediate.py"]
 
 
 def _cli(*args, cwd=REPO):

@@ -1,0 +1,371 @@
+"""Partition-rule sharding engine (parallel.sharding): rule matching,
+per-family coverage, and the two bit-identity acceptance gates — the
+rule-sharded train step vs the hand-wired dp path, and the PBT
+population as a mesh axis vs the per-member Python loop — both on
+forced-CPU virtual devices with a zero-post-warmup-recompile
+CompileCounter gate."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from flax.training.train_state import TrainState
+
+from rlgpuschedule_tpu.algos import PPOConfig, init_carry, make_ppo_step
+from rlgpuschedule_tpu.algos.ppo import make_optimizer
+from rlgpuschedule_tpu.analysis.sentinels import CompileCounter
+from rlgpuschedule_tpu.env import EnvParams, stack_traces
+from rlgpuschedule_tpu.models import HierActorCritic, make_policy
+from rlgpuschedule_tpu.parallel import (DATA_AXIS, MODEL_AXIS, POP_AXIS,
+                                        make_unified_mesh)
+from rlgpuschedule_tpu.parallel import sharding as shardlib
+from rlgpuschedule_tpu.parallel.dp import carry_sharding_prefix, put_carry
+from rlgpuschedule_tpu.parallel.mesh import env_sharded, replicated
+from rlgpuschedule_tpu.sim.core import SimParams
+from rlgpuschedule_tpu.traces import gen_poisson_trace
+
+
+def build(n_envs=8, dtype=jnp.float32):
+    env_params = EnvParams(sim=SimParams(2, 4, max_jobs=16, queue_len=4),
+                           obs_kind="flat", horizon=64, time_scale=100.0,
+                           reward_scale=1000.0)
+    windows = [gen_poisson_trace(0.05, 12, seed=s, max_jobs=16,
+                                 mean_duration=60.0, gpu_sizes=(1, 2),
+                                 gpu_probs=(0.7, 0.3))
+               for s in range(n_envs)]
+    traces = stack_traces(windows, env_params)
+    net = make_policy("flat", env_params.n_actions, dtype=dtype)
+    apply_fn = lambda p, o, m: net.apply(p, o, m)
+    cfg = PPOConfig(n_steps=8, n_epochs=2, n_minibatches=2)
+    key = jax.random.PRNGKey(0)
+    carry = init_carry(env_params, traces, key)
+    params = net.init(key, carry.obs[:1], carry.mask[:1])
+    state = TrainState.create(apply_fn=net.apply, params=params,
+                              tx=make_optimizer(cfg))
+    step = make_ppo_step(apply_fn, env_params, cfg)
+    return env_params, traces, state, carry, step
+
+
+class TestRuleMatching:
+    def test_scalar_and_size1_short_circuit(self):
+        specs = shardlib.match_partition_rules(
+            [], {"step": jnp.int32(0), "ema": jnp.ones((1,))})
+        assert specs["step"] == P() and specs["ema"] == P()
+
+    def test_first_match_wins(self):
+        rules = [(r"kernel$", P(None, MODEL_AXIS)), (r".*", P())]
+        got = shardlib.match_rule(rules, "params/Dense_0/kernel")
+        assert got == P(None, MODEL_AXIS)
+        # reversed order: the catch-all shadows the kernel rule
+        got = shardlib.match_rule(list(reversed(rules)),
+                                  "params/Dense_0/kernel")
+        assert got == P()
+
+    def test_unmatched_leaf_is_a_hard_error(self):
+        with pytest.raises(ValueError, match="Partition rule not found"):
+            shardlib.match_partition_rules(
+                [(r"kernel$", P())], {"weird": jnp.ones((4, 4))})
+
+    def test_rule_table_hash_is_stable_and_order_sensitive(self):
+        h1 = shardlib.rule_table_hash(shardlib.FLAT_RULES)
+        assert h1 == shardlib.rule_table_hash(list(shardlib.FLAT_RULES))
+        h2 = shardlib.rule_table_hash(list(reversed(shardlib.FLAT_RULES)))
+        assert h1 != h2
+
+    def test_prune_spec_drops_axes_the_mesh_lacks(self):
+        # a legacy pop x data mesh (no model axis) must not hard-error on
+        # the unified tables' model-axis specs — those dims replicate
+        import numpy as _np
+        from jax.sharding import Mesh as JMesh
+        legacy = JMesh(_np.array(jax.devices()[:1]).reshape(1, 1),
+                       (POP_AXIS, DATA_AXIS))
+        assert shardlib.prune_spec(
+            P(POP_AXIS, None, MODEL_AXIS), legacy) == P(POP_AXIS)
+        assert shardlib.prune_spec(
+            P((POP_AXIS, MODEL_AXIS), DATA_AXIS), legacy) == \
+            P(POP_AXIS, DATA_AXIS)
+        sh = shardlib.tree_shardings(
+            {"dense/kernel": jnp.ones((4, 4))},
+            [(r"kernel$", P(DATA_AXIS, MODEL_AXIS))], legacy)
+        assert sh["dense/kernel"].spec == P(DATA_AXIS)
+
+
+class TestFamilyCoverage:
+    """Every family's params are fully covered BEFORE the catch-all,
+    and at least one kernel per family actually lands on ``model``."""
+
+    def _covered(self, rules, params):
+        specs = shardlib.match_partition_rules(rules[:-1], params)
+        flat = jax.tree.leaves(
+            specs, is_leaf=lambda x: isinstance(x, P))
+        assert any(MODEL_AXIS in (s or ()) for spec in flat
+                   for s in spec), "no leaf sharded over model"
+
+    def test_flat(self):
+        net = make_policy("flat", 5, dtype=jnp.float32)
+        params = net.init(jax.random.PRNGKey(0), jnp.ones((1, 24)),
+                          jnp.ones((1, 5), bool))
+        self._covered(shardlib.FLAT_RULES, params)
+
+    def test_grid(self):
+        net = make_policy("grid", 5, dtype=jnp.float32)
+        params = net.init(jax.random.PRNGKey(0), jnp.ones((1, 8, 8, 3)),
+                          jnp.ones((1, 5), bool))
+        self._covered(shardlib.GRID_RULES, params)
+        specs = shardlib.match_partition_rules(shardlib.GRID_RULES, params)
+        conv = [s for n, s in zip(shardlib.tree_leaf_names(params),
+                                  jax.tree.leaves(
+                                      specs,
+                                      is_leaf=lambda x: isinstance(x, P)))
+                if "Conv_0/kernel" in n]
+        assert conv == [P(None, None, None, MODEL_AXIS)]
+
+    def test_graph(self):
+        net = make_policy("graph", 5, n_cluster_nodes=2, queue_len=4,
+                          dtype=jnp.float32)
+        V = 2 + 4 + 1
+        params = net.init(jax.random.PRNGKey(0), jnp.ones((1, V, 6)),
+                          jnp.ones((V, V)), jnp.ones((1, 5), bool))
+        self._covered(shardlib.GRAPH_RULES, params)
+
+    def test_hier(self):
+        net = HierActorCritic(n_top_actions=5, n_pod_actions=7,
+                              dtype=jnp.float32)
+        obs = {"top": jnp.ones((1, 16)), "pods": jnp.ones((1, 4, 16))}
+        mask = {"top": jnp.ones((1, 5), bool),
+                "pods": jnp.ones((1, 4, 7), bool)}
+        params = net.init(jax.random.PRNGKey(0), obs, mask)
+        self._covered(shardlib.HIER_RULES, params)
+
+    def test_opt_state_shards_with_the_same_table(self):
+        # Adam moments mirror param paths, so the SAME rules cover the
+        # full TrainState — the zero-extra-configuration property the
+        # re.search matching exists for
+        _, _, state, _, _ = build(n_envs=2)
+        shardlib.match_partition_rules(shardlib.FLAT_RULES, state)
+
+
+class TestBitIdentityVsDP:
+    """Rule-resolved in/out_shardings + bind_mesh constraints vs the
+    hand-wired dp.shard_train path: same 2-device mesh, same seeds —
+    params must be BITWISE identical, and the rule path must not
+    recompile after warmup."""
+
+    def _run_dp(self, iters):
+        from rlgpuschedule_tpu.parallel.dp import shard_train
+        _, traces, state, carry, step = build()
+        mesh = make_unified_mesh(devices=jax.devices()[:2])
+        jstep, state, carry, traces = shard_train(mesh, step, state,
+                                                  carry, traces)
+        for i in range(iters):
+            state, carry, m = jstep(state, carry, traces,
+                                    jax.random.PRNGKey(i))
+        return state, m
+
+    def _run_rules(self, iters):
+        _, traces, state, carry, step = build()
+        mesh = make_unified_mesh(devices=jax.devices()[:2])
+        rules = shardlib.FLAT_RULES
+        state_sh = shardlib.tree_shardings(state, rules, mesh)
+        env, rep = env_sharded(mesh), replicated(mesh)
+        carry_sh = carry_sharding_prefix(mesh)
+        jstep = jax.jit(shardlib.bind_mesh(step, mesh),
+                        in_shardings=(state_sh, carry_sh, env, rep),
+                        out_shardings=(state_sh, carry_sh, rep),
+                        donate_argnums=(0, 1))
+        state = shardlib.put_tree(state, state_sh)
+        carry = put_carry(mesh, carry)
+        traces = shardlib.put_global(traces, env)
+        counted = 0
+        for i in range(iters):
+            if i == 1:
+                cc = CompileCounter()
+                cc.__enter__()
+                counted = 1
+            state, carry, m = jstep(state, carry, traces,
+                                    jax.random.PRNGKey(i))
+        if counted:
+            jax.block_until_ready(jax.tree.leaves(state.params))
+            cc.__exit__(None, None, None)
+            assert cc.total == 0, (
+                f"rule-sharded step recompiled after warmup: "
+                f"{cc.traces} traces, {cc.backend_compiles} compiles")
+        return state, m
+
+    def test_rule_path_matches_dp_bitwise(self):
+        assert len(jax.devices()) >= 2
+        dstate, _ = self._run_dp(3)
+        rstate, _ = self._run_rules(3)
+        for name, d, r in zip(shardlib.tree_leaf_names(dstate.params),
+                              jax.tree.leaves(jax.device_get(
+                                  dstate.params)),
+                              jax.tree.leaves(jax.device_get(
+                                  rstate.params))):
+            assert np.array_equal(np.asarray(d), np.asarray(r)), (
+                f"param {name} diverged between dp and rule paths")
+
+
+class TestBitIdentityPBT:
+    """The population as a ``pop`` mesh axis (ONE dispatch) vs a Python
+    loop of per-member steps: member params identical to last-ulp
+    tolerance, zero post-warmup recompiles."""
+
+    N_POP = 2
+    ITERS = 2
+
+    def _init_population(self):
+        from rlgpuschedule_tpu.parallel.population import (
+            init_member, sample_hparams, stack_members)
+        env_params, traces, _, _, _ = build(n_envs=4)
+        net = make_policy("flat", env_params.n_actions, dtype=jnp.float32)
+        apply_fn = lambda p, o, m: net.apply(p, o, m)
+        cfg = PPOConfig(n_steps=8, n_epochs=2, n_minibatches=2)
+        members, carries = [], []
+        for i in range(self.N_POP):
+            key = jax.random.PRNGKey(100 + i)
+            carry = init_carry(env_params, traces, key)
+            members.append(init_member(net, key, carry.obs[:1],
+                                       carry.mask[:1], cfg))
+            carries.append(carry)
+        hp = sample_hparams(cfg, self.N_POP, seed=0)
+        keys = jnp.stack([jax.random.PRNGKey(500 + i)
+                          for i in range(self.ITERS)])
+        return (env_params, traces, apply_fn, cfg, members, carries, hp,
+                keys, stack_members)
+
+    def test_mesh_axis_matches_python_loop_bitwise(self):
+        from rlgpuschedule_tpu.parallel.population import (
+            jit_population_step, make_member_step, make_population_step)
+        (env_params, traces, apply_fn, cfg, members, carries, hp, keys,
+         stack_members) = self._init_population()
+
+        # --- reference: per-member jitted step in a Python loop
+        member = jax.jit(make_member_step(apply_fn, env_params, cfg))
+        loop_states = [m for m in members]
+        loop_carries = [c for c in carries]
+        for t in range(self.ITERS):
+            mkeys = jax.random.split(keys[t], self.N_POP)
+            for i in range(self.N_POP):
+                hp_i = jax.tree.map(lambda x: x[i], hp)
+                loop_states[i], loop_carries[i], _ = member(
+                    loop_states[i], loop_carries[i], traces, mkeys[i],
+                    hp_i)
+
+        # --- mesh path: stacked members, pop axis, one dispatch/iter
+        mesh = make_unified_mesh(n_pop=self.N_POP,
+                                 devices=jax.devices()[:self.N_POP])
+        states = stack_members(members)
+        carry = stack_members(carries)
+        pop_step = make_population_step(apply_fn, env_params, cfg)
+        jstep = jit_population_step(mesh, pop_step, states=states,
+                                    rules=shardlib.FLAT_RULES)
+        cc = None
+        for t in range(self.ITERS):
+            mkeys = jax.random.split(keys[t], self.N_POP)
+            if t == 1:
+                cc = CompileCounter()
+                cc.__enter__()
+            states, carry, _ = jstep(states, carry, traces, mkeys, hp)
+        jax.block_until_ready(jax.tree.leaves(states.params))
+        if cc is not None:
+            cc.__exit__(None, None, None)
+            assert cc.total == 0, (
+                f"population step recompiled after warmup: {cc.traces} "
+                f"traces, {cc.backend_compiles} compiles")
+
+        # last-ulp tolerance, not bitwise: XLA:CPU emits different dot
+        # kernels for the batched (vmapped) and unbatched member shapes,
+        # so loop/vmap/partitioned-vmap all differ in the final float32
+        # bit after a few updates. Anything beyond ulp noise (a wrong
+        # sharding, a member mixup, hp misalignment) is an O(1)
+        # divergence this still catches.
+        stacked = jax.device_get(states.params)
+        for i in range(self.N_POP):
+            got = jax.tree.map(lambda x: x[i], stacked)
+            want = jax.device_get(loop_states[i].params)
+            for name, g, w in zip(shardlib.tree_leaf_names(want),
+                                  jax.tree.leaves(got),
+                                  jax.tree.leaves(want)):
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(w), rtol=2e-5, atol=1e-7,
+                    err_msg=(f"member {i} param {name} diverged between "
+                             f"mesh and loop paths"))
+
+
+class TestElasticByRule:
+    def test_key_leaf_is_protected_by_name(self):
+        # a PRNG key whose length coincides with old_n_envs: the rule
+        # path keeps it whole, the deprecated dim heuristic slices it
+        old_n_envs, old_world = 8, 4
+        tree = {"obs": np.arange(8 * 3, dtype=np.float32).reshape(8, 3),
+                "done": np.zeros(8, bool),
+                "key": np.arange(8, dtype=np.uint32)}
+        out = shardlib.shrink_env_rows_by_rule(
+            tree, shardlib.ELASTIC_EXTRA_RULES, old_n_envs=old_n_envs,
+            old_world=old_world, surviving_ranks=[0, 2])
+        assert out["obs"].shape == (4, 3)
+        assert out["done"].shape == (4,)
+        assert out["key"].shape == (8,)          # preserved by name
+        np.testing.assert_array_equal(out["key"], tree["key"])
+        np.testing.assert_array_equal(out["obs"],
+                                      tree["obs"][[0, 1, 4, 5]])
+
+    def test_dp_shim_warns_and_keeps_dim_keyed_behavior(self):
+        from rlgpuschedule_tpu.parallel import dp
+        tree = {"key": np.arange(8, dtype=np.uint32)}
+        with pytest.warns(DeprecationWarning, match="shrink_env_rows"):
+            out = dp.shrink_env_rows(tree, old_n_envs=8, old_world=4,
+                                     surviving_ranks=[0, 2])
+        assert out["key"].shape == (4,)          # the old caveat, exactly
+
+    def test_put_global_shim_warns_and_places(self):
+        from rlgpuschedule_tpu.parallel import dp
+        mesh = make_unified_mesh(devices=jax.devices()[:2])
+        with pytest.warns(DeprecationWarning, match="put_global"):
+            out = dp.put_global(jnp.ones((4, 2)), env_sharded(mesh))
+        assert out.sharding.mesh.shape[DATA_AXIS] == 2
+
+    def test_invalid_survivors_raise(self):
+        with pytest.raises(ValueError, match="surviving_ranks"):
+            shardlib.shrink_env_rows_by_rule(
+                {"a": np.zeros((8,))}, shardlib.ELASTIC_EXTRA_RULES,
+                old_n_envs=8, old_world=4, surviving_ranks=[0, 7])
+
+
+class TestUnifiedMesh:
+    def test_three_axis_shape_and_validation(self):
+        m = make_unified_mesh(n_pop=2, n_model=2)
+        assert (m.shape[POP_AXIS], m.shape[DATA_AXIS],
+                m.shape[MODEL_AXIS]) == (2, 2, 2)
+        with pytest.raises(ValueError):
+            make_unified_mesh(n_pop=3)
+
+    def test_split_mesh_partitions_devices(self):
+        from rlgpuschedule_tpu.parallel import split_mesh
+        groups = split_mesh(make_unified_mesh(), actor=2)
+        assert len(groups.actor) == 2
+        assert len(groups.learner) == len(jax.devices()) - 2
+
+
+class TestModeTable:
+    def test_every_refusal_names_known_modes(self):
+        from rlgpuschedule_tpu.configs import MODE_FLAGS, MODE_REFUSALS
+        for a, b, why in MODE_REFUSALS:
+            assert a in MODE_FLAGS and b in MODE_FLAGS and why
+
+    def test_error_format_carries_both_flag_spellings(self):
+        from rlgpuschedule_tpu.configs import (MODE_FLAGS, MODE_REFUSALS,
+                                               ModeCombinationError,
+                                               validate_mode_combination)
+        for a, b, _ in MODE_REFUSALS:
+            with pytest.raises(ModeCombinationError) as ei:
+                validate_mode_combination({a: True, b: True})
+            assert MODE_FLAGS[a] in str(ei.value)
+            assert MODE_FLAGS[b] in str(ei.value)
+
+    def test_inactive_and_unknown_modes(self):
+        from rlgpuschedule_tpu.configs import validate_mode_combination
+        validate_mode_combination({"async": True, "pbt": False})
+        with pytest.raises(KeyError, match="unknown mode"):
+            validate_mode_combination({"warp_drive": True})
