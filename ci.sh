@@ -53,17 +53,49 @@ echo "=== smoke: observability (3-iter CPU run + merged-timeline report) ==="
 # in one exit code (ISSUE 5 acceptance).
 OBS_DIR=$(mktemp -d /tmp/ci_obs.XXXXXX)
 ASYNC_OBS_DIR=$(mktemp -d /tmp/ci_async_obs.XXXXXX)
+SERVE_OBS_DIR=$(mktemp -d /tmp/ci_serve_obs.XXXXXX)
 CHAOS_JSON=$(mktemp /tmp/ci_chaos.XXXXXX.json)
 SERVE_JSON=$(mktemp /tmp/ci_serve.XXXXXX.json)
-trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON"' EXIT
+TRACE_JSON=$(mktemp /tmp/ci_trace.XXXXXX.json)
+trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$SERVE_OBS_DIR" "$CHAOS_JSON" \
+    "$SERVE_JSON" "$TRACE_JSON"' EXIT
+# --trace-spans rides along (ISSUE 11): the flight recorder must not
+# disturb the strict-alarms gate, and the exported Chrome trace must be
+# Perfetto-valid (validated per layer below)
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python -m rlgpuschedule_tpu.train --config ppo-mlp-synth64 \
     --iterations 3 --n-envs 4 --n-nodes 2 --gpus-per-node 4 \
     --window-jobs 16 --horizon 64 --queue-len 4 --n-steps 8 \
     --n-epochs 1 --n-minibatches 2 --log-every 1 \
-    --obs-dir "$OBS_DIR" --alarms > /dev/null
+    --obs-dir "$OBS_DIR" --alarms --trace-spans > /dev/null
+# Perfetto-validity gate, shared by the sync/async/serve layers: the
+# Chrome trace must load as JSON, every (pid,tid) track must carry
+# strictly paired B/E events, at least one span must nest (depth >= 2),
+# and a clean run must contain no torn spans.
+validate_trace() {  # $1 = trace json path, $2 = layer label
+python - "$1" "$2" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))   # valid JSON or this line throws
+depth, max_depth = {}, 0
+for e in doc["traceEvents"]:
+    if e["ph"] not in ("B", "E"):
+        continue
+    key = (e["pid"], e["tid"])
+    depth[key] = depth.get(key, 0) + (1 if e["ph"] == "B" else -1)
+    assert depth[key] >= 0, f"unpaired E on {key}"
+    max_depth = max(max_depth, depth[key])
+assert not any(depth.values()), f"unpaired B: {depth}"
+assert not any(e.get("args", {}).get("torn")
+               for e in doc["traceEvents"]), "torn spans in a clean run"
+assert max_depth >= 2, f"expected nested spans, max depth {max_depth}"
+print(f"trace smoke ok ({sys.argv[2]}): "
+      f"{len(doc['traceEvents'])} events, max span depth {max_depth}")
+EOF
+}
 timeout -k 10 60 env JAX_PLATFORMS=cpu \
-    python -m rlgpuschedule_tpu.obs.report "$OBS_DIR" --strict-alarms
+    python -m rlgpuschedule_tpu.obs.report "$OBS_DIR" --strict-alarms \
+    --trace-out "$TRACE_JSON" > /dev/null
+validate_trace "$TRACE_JSON" sync
 
 echo "=== smoke: async actor-learner (3-iter overlapped run, 2 CPU devices) ==="
 # ISSUE 9 acceptance: a telemetry-instrumented train --async run on a
@@ -79,21 +111,38 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
     --iterations 3 --n-envs 4 --n-nodes 2 --gpus-per-node 4 \
     --window-jobs 16 --horizon 64 --queue-len 4 --n-steps 8 \
     --n-epochs 1 --n-minibatches 2 --log-every 1 \
-    --obs-dir "$ASYNC_OBS_DIR" --alarms > /dev/null
+    --obs-dir "$ASYNC_OBS_DIR" --alarms --trace-spans > /dev/null
+# ISSUE 11 acceptance: the traced async run exports a Perfetto-valid
+# trace AND the report upgrades the overlap headline from the phase-time
+# projection to measured occupancy (async_overlap_measured)
 timeout -k 10 60 env JAX_PLATFORMS=cpu \
-    python -m rlgpuschedule_tpu.obs.report "$ASYNC_OBS_DIR" --strict-alarms
+    python -m rlgpuschedule_tpu.obs.report "$ASYNC_OBS_DIR" --strict-alarms \
+    --trace-out "$TRACE_JSON" | tee /tmp/_async_report.log
+grep -q "async_overlap_measured" /tmp/_async_report.log
+validate_trace "$TRACE_JSON" async
 python - "$ASYNC_OBS_DIR" <<'EOF'
 import sys
 from rlgpuschedule_tpu.obs import merge_dir
+from rlgpuschedule_tpu.obs.trace import SPAN_BEGIN, async_overlap_summary
 events = merge_dir(sys.argv[1])
 end = next(e for e in events if e["kind"] == "run_end")
 ph = end["phase_seconds"]
 assert ph.get("actor", 0) > 0 and ph.get("learner", 0) > 0, ph
 assert "async_overlap_s" in end and "async_staleness_max" in end, end
 assert not [e for e in events if e["kind"] == "recompile"], "recompiles"
+# the actor thread and the learner (caller) thread must land on
+# DISTINCT tracks — that is what makes the occupancy math meaningful
+begins = [e for e in events if e["kind"] == SPAN_BEGIN]
+tids = {e["tid"] for e in begins if e["span"] in ("actor", "learner")}
+assert len(tids) == 2, f"actor/learner share a track: {tids}"
+occ = async_overlap_summary(events)
+assert occ is not None, "no actor/learner spans in the traced async run"
+measured = occ["async_overlap_measured"]
+assert 0 < measured <= 1, occ
 print("async smoke ok:", {"actor_s": round(ph["actor"], 3),
                           "learner_s": round(ph["learner"], 3),
                           "overlap_s": round(end["async_overlap_s"], 3),
+                          "overlap_measured": round(measured, 3),
                           "staleness_max": end["async_staleness_max"]})
 EOF
 
@@ -131,7 +180,23 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
     --bench --fleet 2 --bucket 8 --rounds 9 --pool-steps 2 \
     --n-envs 2 --n-nodes 2 --gpus-per-node 4 --window-jobs 16 \
     --queue-len 4 --horizon 64 --max-steps 96 \
+    --obs-dir "$SERVE_OBS_DIR" --trace-spans \
     --metrics-port 0 > "$SERVE_JSON"
+# the request lifecycle must land on the flight recorder too:
+# serve_batch > stack / (engine) pad > dispatch > scatter
+timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.obs.report "$SERVE_OBS_DIR" \
+    --trace-out "$TRACE_JSON" > /dev/null
+validate_trace "$TRACE_JSON" serve
+python - "$SERVE_OBS_DIR" <<'EOF'
+import sys
+from rlgpuschedule_tpu.obs import merge_dir
+from rlgpuschedule_tpu.obs.trace import SPAN_BEGIN
+names = {e["span"] for e in merge_dir(sys.argv[1])
+         if e["kind"] == SPAN_BEGIN}
+need = {"serve_batch", "stack", "pad", "dispatch", "scatter"}
+assert need <= names, f"missing serve spans: {sorted(need - names)}"
+EOF
 python - "$SERVE_JSON" <<'EOF'
 import json, sys
 rep = json.load(open(sys.argv[1]))
@@ -161,7 +226,8 @@ MESH_OBS_DIR=$(mktemp -d /tmp/ci_mesh_obs.XXXXXX)
 PBT_OBS_DIR=$(mktemp -d /tmp/ci_pbt_obs.XXXXXX)
 MESH_JSON=$(mktemp /tmp/ci_mesh.XXXXXX.json)
 PBT_JSON=$(mktemp /tmp/ci_pbt.XXXXXX.json)
-trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON" \
+trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$SERVE_OBS_DIR" "$CHAOS_JSON" \
+    "$SERVE_JSON" "$TRACE_JSON" \
     "$MESH_OBS_DIR" "$PBT_OBS_DIR" "$MESH_JSON" "$PBT_JSON"' EXIT
 # JAX_ENABLE_COMPILATION_CACHE=false on BOTH mesh trains: the persistent
 # compile cache flakily heap-corrupts (malloc_consolidate / segfault,

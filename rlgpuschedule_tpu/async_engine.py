@@ -88,6 +88,7 @@ from .algos.ppo import make_learn_step as make_ppo_learn_step
 from .algos.rollout import make_rollout_step
 from .analysis.sentinels import no_implicit_transfers
 from .obs.telemetry import AsyncGauges, OverlapMeter
+from .obs.trace import tracer_of
 from .parallel.dp import put_carry
 from .parallel.groups import DeviceGroups
 from .parallel.sharding import put_global
@@ -401,23 +402,31 @@ class AsyncRunner:
     # -- the actor loop (background thread) --------------------------------
 
     def _actor_loop(self, base: int, iterations: int,
-                    sections: SectionTimer) -> None:
+                    sections: SectionTimer, tracer) -> None:
         exp = self.exp
         carry = exp.carry
         try:
             for k in range(iterations):
                 i = base + k
-                self._actor_idle_s += self._wait_barriers_before(i)
+                # the flight recorder's actor track: the two wait spans
+                # (barrier park + staleness gate) and the push-side
+                # backpressure are the idle gaps the occupancy timeline
+                # exists to show; the "actor" span is the busy lane the
+                # measured-overlap summary unions against "learner"
+                with tracer.span("actor_barrier_wait"):
+                    self._actor_idle_s += self._wait_barriers_before(i)
                 # staleness gate: may not collect batch i until the
                 # learner is within `bound` versions; always take the
                 # freshest publication (ISSUE: "refresh actor params
                 # from the learner at each publish")
-                params, version, gated = self._slot.wait_for(
-                    i - self.staleness_bound)
+                with tracer.span("actor_gate_wait"):
+                    params, version, gated = self._slot.wait_for(
+                        i - self.staleness_bound)
                 self._actor_idle_s += gated
                 # barrier-park may have replaced the carry (resample)
                 carry = exp.carry
-                with self.overlap.span("actor"), sections("actor"), \
+                with tracer.span("actor", iteration=i), \
+                        self.overlap.span("actor"), sections("actor"), \
                         no_implicit_transfers(), self._dispatch_lock:
                     carry, tr, last_value = self._rollout(
                         params, carry, exp.traces, self._faults)
@@ -428,8 +437,9 @@ class AsyncRunner:
                              jax.device_put(last_value, self._lenv))
                     jax.block_until_ready(batch)
                 exp.carry = carry
-                self._actor_idle_s += self.queue.put(
-                    _QueueItem(index=i, version=version, batch=batch))
+                with tracer.span("queue_push_wait"):
+                    self._actor_idle_s += self.queue.put(
+                        _QueueItem(index=i, version=version, batch=batch))
         except _Aborted:
             pass
         except BaseException as e:  # surface in the learner thread
@@ -459,6 +469,7 @@ class AsyncRunner:
                     else SectionTimer())
         gauges = (AsyncGauges(telemetry.registry)
                   if telemetry is not None else None)
+        tracer = tracer_of(telemetry)
 
         def is_ckpt(b: int) -> bool:
             return bool(ckpt is not None and ckpt_every
@@ -490,7 +501,8 @@ class AsyncRunner:
 
         t0 = time.monotonic()
         actor = threading.Thread(
-            target=self._actor_loop, args=(base, iterations, sections),
+            target=self._actor_loop,
+            args=(base, iterations, sections, tracer),
             name="async-actor", daemon=True)
         actor.start()
         try:
@@ -499,7 +511,8 @@ class AsyncRunner:
                 i = base + k
                 if telemetry is not None:
                     telemetry.begin_iteration(b)
-                with sections("queue_wait"):
+                with sections("queue_wait"), \
+                        tracer.span("queue_pop_wait"):
                     item, waited = self.queue.get()
                 self._learner_idle_s += waited
                 if item.index != i:
@@ -519,8 +532,9 @@ class AsyncRunner:
                 guard = (telemetry.dispatch(b) if telemetry is not None
                          else contextlib.nullcontext())
                 tr, last_value = item.batch
-                with self.overlap.span("learner"), sections("learner"), \
-                        guard, self._dispatch_lock:
+                with tracer.span("learner", iteration=b), \
+                        self.overlap.span("learner"), \
+                        sections("learner"), guard, self._dispatch_lock:
                     # the sync loop's per-iteration split, in the same order
                     exp.key, sub = jax.random.split(exp.key)
                     state, metrics = self._learn(exp.train_state, tr,
@@ -534,7 +548,8 @@ class AsyncRunner:
                                                 or b == iterations - 1)
                 m = None
                 if want_log:
-                    with sections("sync"), self._dispatch_lock:
+                    with sections("sync"), tracer.span("sync"), \
+                            self._dispatch_lock:
                         m = {k2: float(v) for k2, v in
                              jax.device_get(metrics)._asdict().items()}
                     history.append({"iteration": b, **m})
@@ -549,21 +564,22 @@ class AsyncRunner:
                             overlap_s=self.overlap.overlap_s)
                 if eval_fn is not None and eval_every and \
                         ((b + 1) % eval_every == 0 or b == iterations - 1):
-                    with sections("eval"), self._dispatch_lock:
+                    with sections("eval"), tracer.span("eval"), \
+                            self._dispatch_lock:
                         em = dict(eval_fn(b))
                     eval_history.append({"iteration": b, **em})
                     if eval_logger is not None:
                         eval_logger(b, em)
                 # drained-queue barrier work (actor is parked past i)
                 if is_ckpt(b):
-                    with sections("ckpt"):
+                    with sections("ckpt"), tracer.span("ckpt"):
                         exp.save_checkpoint(
                             ckpt, meta={"iteration": b,
                                         "async_iteration": i,
                                         "staleness_bound":
                                             self.staleness_bound})
                 if is_resample(b):
-                    with sections("resample"):
+                    with sections("resample"), tracer.span("resample"):
                         self._resample()
                 if is_ckpt(b) or is_resample(b):
                     self._complete_barrier()

@@ -729,7 +729,7 @@ def _chaos_conservation(states, traces, env_params: EnvParams) -> dict:
 def chaos_report(exp, regimes: tuple[str, ...] = CHAOS_REGIMES,
                  baselines: tuple[str, ...] = ("sjf", "tiresias"),
                  max_steps: int | None = None, seed: int = 0,
-                 bus=None, registry=None) -> dict[str, Any]:
+                 bus=None, registry=None, tracer=None) -> dict[str, Any]:
     """The regime × scheduler chaos matrix (``evaluate --chaos``): replay
     the trained policy AND the oracle baselines over the experiment's
     windows under identical seeded fault schedules, one column per
@@ -753,10 +753,16 @@ def chaos_report(exp, regimes: tuple[str, ...] = CHAOS_REGIMES,
     ``bus`` (:class:`obs.EventBus`) emits one ``env_fault`` event per
     matrix cell plus per-regime schedule stats; ``registry``
     (:class:`obs.Registry`) gains ``chaos_<regime>_<scheduler>_*``
-    gauges — the chaos story ``obs.report`` renders."""
+    gauges — the chaos story ``obs.report`` renders. ``tracer``
+    (:class:`obs.Tracer`, ``evaluate --trace-spans``) records each
+    regime row as a ``chaos_regime`` span nesting the ``policy_replay``
+    and per-``baseline`` extents."""
+    from .obs.trace import NULL_TRACER
     from .sim.faults import (fault_horizon, resolve_regime,
                              sample_fault_schedule, schedule_stats,
                              stack_fault_schedules)
+    if tracer is None:
+        tracer = NULL_TRACER
     if isinstance(exp.env_params, HierParams):
         raise ValueError("chaos evaluation supports flat configs (the "
                          "hierarchical env has no fault-process support)")
@@ -770,36 +776,42 @@ def chaos_report(exp, regimes: tuple[str, ...] = CHAOS_REGIMES,
         "chaos_regimes": list(regimes), "jobs_lost": 0,
         "regimes": {}, "fault_stats": {}}
     for name in regimes:
-        regime = resolve_regime(name)
-        host = [sample_fault_schedule(n_nodes, regime, (seed, e),
-                                      horizon_s)
-                for e in range(len(windows))]
-        batched = stack_fault_schedules(host)
-        report["fault_stats"][name] = schedule_stats(batched)
-        res, states = replay(exp.apply_fn, exp.train_state.params,
-                             env_params, traces, max_steps,
-                             return_states=True, faults=batched)
-        cons = _chaos_conservation(states, traces, env_params)
-        if not cons["conserved"]:
-            raise AssertionError(
-                f"conservation violated under regime {name!r}: "
-                f"{cons} — a fault schedule must delay jobs, never "
-                f"leak them or their GPUs")
-        report["jobs_lost"] += cons["jobs_lost"]
-        jct, completion = pooled_avg_jct(res)
-        rows: dict[str, Any] = {
-            "policy": {"avg_jct": jct, "completion": completion}}
-        for bname in baselines:
-            jcts, n_valid = [], 0
-            for w, fs in zip(windows, host):
-                bl = run_baseline(w, n_nodes, g, bname, faults=fs)
-                jcts.append(bl.jcts())
-                n_valid += w.num_jobs
-            pooled = np.concatenate(jcts) if jcts else np.zeros(0)
-            rows[bname] = {
-                "avg_jct": float(pooled.mean()) if pooled.size else 0.0,
-                "completion": float(pooled.size / max(n_valid, 1))}
-        report["regimes"][name] = rows
+        with tracer.span("chaos_regime", regime=name):
+            regime = resolve_regime(name)
+            host = [sample_fault_schedule(n_nodes, regime, (seed, e),
+                                          horizon_s)
+                    for e in range(len(windows))]
+            batched = stack_fault_schedules(host)
+            report["fault_stats"][name] = schedule_stats(batched)
+            with tracer.span("policy_replay"):
+                res, states = replay(exp.apply_fn,
+                                     exp.train_state.params,
+                                     env_params, traces, max_steps,
+                                     return_states=True, faults=batched)
+            cons = _chaos_conservation(states, traces, env_params)
+            if not cons["conserved"]:
+                raise AssertionError(
+                    f"conservation violated under regime {name!r}: "
+                    f"{cons} — a fault schedule must delay jobs, never "
+                    f"leak them or their GPUs")
+            report["jobs_lost"] += cons["jobs_lost"]
+            jct, completion = pooled_avg_jct(res)
+            rows: dict[str, Any] = {
+                "policy": {"avg_jct": jct, "completion": completion}}
+            for bname in baselines:
+                jcts, n_valid = [], 0
+                with tracer.span("baseline", scheduler=bname):
+                    for w, fs in zip(windows, host):
+                        bl = run_baseline(w, n_nodes, g, bname,
+                                          faults=fs)
+                        jcts.append(bl.jcts())
+                        n_valid += w.num_jobs
+                pooled = np.concatenate(jcts) if jcts else np.zeros(0)
+                rows[bname] = {
+                    "avg_jct": (float(pooled.mean()) if pooled.size
+                                else 0.0),
+                    "completion": float(pooled.size / max(n_valid, 1))}
+            report["regimes"][name] = rows
     clean = report["regimes"]["none"]
     for name, rows in report["regimes"].items():
         for sched, row in rows.items():

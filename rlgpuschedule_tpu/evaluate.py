@@ -94,6 +94,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(JSONL event bus) and chaos_* gauges "
                         "(metrics.prom) under this directory so "
                         "obs.report can tell the chaos story")
+    p.add_argument("--trace-spans", action="store_true",
+                   help="with --chaos --obs-dir: flight recorder — "
+                        "record each regime row as nested "
+                        "chaos_regime/policy_replay/baseline spans on "
+                        "the event bus (export via obs.report "
+                        "--trace-out). NOT --trace, which would be the "
+                        "workload trace source")
     p.add_argument("--ckpt-dir", default=None,
                    help="restore the trained policy from this checkpoint "
                         "dir (omit = untrained init weights)")
@@ -232,6 +239,10 @@ def main(argv: list[str] | None = None) -> dict:
         sys.exit("--chaos-regimes/--obs-dir configure the --chaos "
                  "matrix; pass --chaos with them (refusing the silent "
                  "no-op)")
+    if args.trace_spans and not (args.chaos and args.obs_dir):
+        sys.exit("--trace-spans records spans on the chaos event bus; "
+                 "pass --chaos and --obs-dir with it (refusing the "
+                 "silent no-op)")
 
     # the full reproducibility tuple every evaluate JSON carries: enough
     # to regenerate any row (chaos-matrix rows included) exactly —
@@ -321,17 +332,21 @@ def main(argv: list[str] | None = None) -> dict:
         import os
 
         from .eval import CHAOS_REGIMES, chaos_report, format_chaos
-        bus = registry = None
+        bus = registry = tracer = None
         if args.obs_dir:
             from .obs import EventBus, Registry
             bus = EventBus(os.path.abspath(args.obs_dir), rank=0,
                            name="chaos")
             registry = Registry()
+            if args.trace_spans:
+                from .obs.trace import Tracer
+                tracer = Tracer(bus, enabled=True)
         try:
             report = chaos_report(
                 exp, regimes=regimes or CHAOS_REGIMES,
                 baselines=chaos_baselines, max_steps=args.max_steps,
-                seed=args.chaos_seed, bus=bus, registry=registry)
+                seed=args.chaos_seed, bus=bus, registry=registry,
+                tracer=tracer)
         finally:
             if bus is not None:
                 bus.close()

@@ -524,9 +524,11 @@ class Experiment:
         # telemetry spans: with no telemetry attached, a throwaway timer
         # keeps the section sites branch-free (its cost is two
         # perf_counter reads per section — noise next to a dispatch)
+        from .obs.trace import tracer_of
         from .utils.profiling import SectionTimer
         sections = (telemetry.sections if telemetry is not None
                     else SectionTimer())
+        tracer = tracer_of(telemetry)
         if telemetry is not None:
             telemetry.run_start(
                 loop="experiment", config=self.cfg.name,
@@ -561,13 +563,13 @@ class Experiment:
             # "step" is the async dispatch only — the device work it
             # enqueues materializes in the "sync" span's device_get
             if fused_chunk > 1:
-                with sections("step"), guard:
+                with sections("step"), tracer.span("step"), guard:
                     metrics = self.run_fused(fused_chunk)
             else:
                 self.key, sub = jax.random.split(self.key)
                 if key_rep is not None:
                     sub = jax.device_put(sub, key_rep)
-                with sections("step"), guard:
+                with sections("step"), tracer.span("step"), guard:
                     self.train_state, self.carry, metrics = self.train_step(
                         self.train_state, self.carry, self.traces, sub,
                         self.faults)
@@ -583,7 +585,7 @@ class Experiment:
             # iteration (jsan host-sync review, PR 3)
             m = None
             if watchdog is not None or want_log:
-                with sections("sync"):
+                with sections("sync"), tracer.span("sync"):
                     m = {k: float(v) for k, v in
                          jax.device_get(metrics)._asdict().items()}
             if watchdog is not None:
@@ -603,21 +605,21 @@ class Experiment:
                     logger(b, m)
             if eval_fn is not None and eval_every and \
                     ((b + 1) % eval_every == 0 or b == iterations - 1):
-                with sections("eval"):
+                with sections("eval"), tracer.span("eval"):
                     em = dict(eval_fn(b))
                 eval_history.append({"iteration": b, **em})
                 if eval_logger is not None:
                     eval_logger(b, em)
             if ckpt is not None and ckpt_every and \
                     ((b + 1) % ckpt_every == 0 or b == iterations - 1):
-                with sections("ckpt"):
+                with sections("ckpt"), tracer.span("ckpt"):
                     self.save_checkpoint(ckpt, meta={"iteration": b})
                 if injector is not None:
                     injector.corrupt_after_save(ckpt, b)
             if self.cfg.resample_every and \
                     (b + 1) % self.cfg.resample_every == 0 and \
                     b != iterations - 1:
-                with sections("resample"):
+                with sections("resample"), tracer.span("resample"):
                     self.advance_windows()
             if telemetry is not None:
                 telemetry.end_iteration(
@@ -894,9 +896,11 @@ class PopulationExperiment:
         history = []
         eval_history = []
         t0 = time.monotonic()
+        from .obs.trace import tracer_of
         from .utils.profiling import SectionTimer
         sections = (telemetry.sections if telemetry is not None
                     else SectionTimer())
+        tracer = tracer_of(telemetry)
         if telemetry is not None:
             telemetry.run_start(
                 loop="population", config=self.cfg.name,
@@ -913,7 +917,7 @@ class PopulationExperiment:
                      else contextlib.nullcontext())
             both = split_all(self.keys)
             self.keys, subs = both[:, 0], both[:, 1]
-            with sections("step"), guard:
+            with sections("step"), tracer.span("step"), guard:
                 self.states, self.carries, metrics = self.pop_step(
                     self.states, self.carries, self.traces, subs,
                     self.hparams)
@@ -956,7 +960,7 @@ class PopulationExperiment:
                 # per-element float() was n_fields x P separate blocking
                 # transfers per logged iteration (jsan host-sync review)
                 m = {}
-                with sections("sync"):
+                with sections("sync"), tracer.span("sync"):
                     got = jax.device_get(metrics)._asdict()
                 for k, v in got.items():
                     vals = [float(x) for x in v]
@@ -967,14 +971,14 @@ class PopulationExperiment:
                     logger(i, m)
             if eval_fn is not None and eval_every and \
                     ((i + 1) % eval_every == 0 or i == iterations - 1):
-                with sections("eval"):
+                with sections("eval"), tracer.span("eval"):
                     em = dict(eval_fn(i))
                 eval_history.append({"iteration": i, **em})
                 if eval_logger is not None:
                     eval_logger(i, em)
             if ckpt is not None and ckpt_every and \
                     ((i + 1) % ckpt_every == 0 or i == iterations - 1):
-                with sections("ckpt"):
+                with sections("ckpt"), tracer.span("ckpt"):
                     self.save_checkpoint(ckpt, meta={"iteration": i})
                 if injector is not None:
                     injector.corrupt_after_save(ckpt, i)

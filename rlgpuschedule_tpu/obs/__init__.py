@@ -23,13 +23,24 @@ to *when and on which rank*.
   from test-only sentinels to production: ``recompile``/``transfer``
   events, optional slow-iteration ``jax.profiler`` auto-capture).
 - :mod:`.report` — ``python -m rlgpuschedule_tpu.obs.report <dir>``:
-  merged timeline post-mortem (phase-time table, restart/rollback
-  history, steps/s curve, alarm summary; ``--strict-alarms`` for CI).
+  merged timeline post-mortem (phase-time table, span tree, restart/
+  rollback history, steps/s curve, alarm summary; ``--strict-alarms``
+  for CI, ``--trace-out`` for the Perfetto export).
+- :mod:`.trace` — the span-tracing flight recorder: nestable,
+  thread-aware :meth:`Tracer.span` extents on the same bus (track =
+  ``(rank, thread)``), plus :func:`to_chrome_trace` so any run opens in
+  Perfetto / ``chrome://tracing``.
+- :mod:`.skew` — the cross-host clock-skew handshake: ranks stamp
+  ``(wall, mono)`` offset samples; :func:`correct_events` rewrites a
+  merged timeline onto one corrected monotonic axis with a residual-
+  uncertainty annotation.
 
 Event kinds by emitter:
 
 == run loops (``experiment.py``): ``run_start``, ``iteration``,
    ``run_end``, ``pbt_exploit``
+== tracer (any layer, ``--trace``): ``span_begin``, ``span_end``,
+   ``span_point``
 == alarms: ``compile`` (warmup/expected), ``recompile``, ``transfer``,
    ``slow_iteration``, ``profile_captured``
 == checkpoint: ``ckpt_save``, ``ckpt_restore``, ``ckpt_reject``,
@@ -38,17 +49,25 @@ Event kinds by emitter:
 == supervisor: ``gang_launch``, ``rank_failure``, ``gang_restart``,
    ``gang_shrink``, ``supervisor_done``
 == multihost worker: ``worker_start``, ``worker_resumed``,
-   ``worker_step``, ``worker_done``
+   ``worker_step``, ``worker_done``, ``clock_skew``
 """
 from .events import (EventBus, SCHEMA_VERSION, event_streams, merge_dir,
                      merge_events, read_events)
-from .metrics import (Counter, Gauge, MetricsHTTPServer, Registry,
-                      serve_http)
+from .metrics import (Counter, Gauge, Histogram, MetricsHTTPServer,
+                      Registry, serve_http)
+from .skew import (RankSkew, correct_events, learn_offsets,
+                   merge_dir_corrected)
 from .telemetry import AlarmError, Alarms, RunTelemetry
+from .trace import (NULL_TRACER, Tracer, async_overlap_summary,
+                    build_span_tree, to_chrome_trace, tracer_of)
 
 __all__ = [
     "EventBus", "SCHEMA_VERSION", "event_streams", "merge_dir",
     "merge_events", "read_events",
-    "Counter", "Gauge", "MetricsHTTPServer", "Registry", "serve_http",
+    "Counter", "Gauge", "Histogram", "MetricsHTTPServer", "Registry",
+    "serve_http",
     "AlarmError", "Alarms", "RunTelemetry",
+    "NULL_TRACER", "Tracer", "async_overlap_summary", "build_span_tree",
+    "to_chrome_trace", "tracer_of",
+    "RankSkew", "correct_events", "learn_offsets", "merge_dir_corrected",
 ]

@@ -28,6 +28,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import threading
 import time
 from typing import IO, Any, Callable, Iterable
 
@@ -67,6 +68,10 @@ class EventBus:
         self._clock = clock
         self._wall = wall
         self._seq = 0
+        # the async engine's actor thread and the learner (caller)
+        # thread share one rank's bus: serialize the stamp+write so seq
+        # stays gapless and lines never interleave mid-record
+        self._emit_lock = threading.Lock()
         self._file: IO[str] | None = open(self.path, "a")
 
     def emit(self, kind: str, **fields: Any) -> dict:
@@ -79,12 +84,13 @@ class EventBus:
         if bad:
             raise ValueError(f"event field(s) {bad} shadow the bus's own "
                              f"stamp fields {RESERVED_FIELDS}")
-        event = {"v": SCHEMA_VERSION, "kind": kind, "rank": self.rank,
-                 "pid": os.getpid(), "seq": self._seq,
-                 "mono": self._clock(), "wall": self._wall(), **fields}
-        self._seq += 1
-        self._file.write(json.dumps(event, sort_keys=True) + "\n")
-        self._file.flush()
+        with self._emit_lock:
+            event = {"v": SCHEMA_VERSION, "kind": kind, "rank": self.rank,
+                     "pid": os.getpid(), "seq": self._seq,
+                     "mono": self._clock(), "wall": self._wall(), **fields}
+            self._seq += 1
+            self._file.write(json.dumps(event, sort_keys=True) + "\n")
+            self._file.flush()
         return event
 
     def close(self) -> None:

@@ -58,6 +58,59 @@ class Gauge:
         self.value = float(v)
 
 
+class Histogram:
+    """Prometheus histogram: cumulative ``_bucket{le=...}`` counts plus
+    ``_sum``/``_count`` (text exposition format 0.0.4), so scrape-side
+    ``histogram_quantile()`` computes p50/p99 across restarts and ranks
+    without any in-process sample list. Buckets are fixed at
+    registration (a histogram whose buckets move between scrapes is
+    unaggregatable); the default ladder suits sub-second latencies.
+    """
+
+    kind = "histogram"
+
+    DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                       0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: tuple[float, ...] | None = None):
+        self.name = name
+        self.help = help
+        b = tuple(float(x) for x in
+                  (buckets if buckets is not None else
+                   self.DEFAULT_BUCKETS))
+        if not b or list(b) != sorted(b) or len(set(b)) != len(b):
+            raise ValueError(f"histogram {name}: buckets must be a "
+                             f"non-empty strictly increasing sequence, "
+                             f"got {b}")
+        self.buckets = b
+        self._counts = [0] * len(b)     # per-bucket (non-cumulative)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.sum += v
+        self.count += 1
+        for i, le in enumerate(self.buckets):
+            if v <= le:
+                self._counts[i] += 1
+                break
+
+    def cumulative(self) -> list[tuple[float, int]]:
+        """``(le, cumulative_count)`` rows; the implicit ``+Inf`` bucket
+        (== ``count``) is the renderer's last line."""
+        out, acc = [], 0
+        for le, n in zip(self.buckets, self._counts):
+            acc += n
+            out.append((le, acc))
+        return out
+
+
+def _fmt_le(le: float) -> str:
+    return f"{le:g}"
+
+
 class Registry:
     """Flat name -> metric registry.
 
@@ -68,7 +121,7 @@ class Registry:
     """
 
     def __init__(self):
-        self._metrics: dict[str, Union[Counter, Gauge]] = {}
+        self._metrics: dict[str, Union[Counter, Gauge, Histogram]] = {}
 
     def _register(self, cls, name: str, help: str):
         if not _NAME_RE.match(name):
@@ -91,16 +144,47 @@ class Registry:
     def gauge(self, name: str, help: str = "") -> Gauge:
         return self._register(Gauge, name, help)
 
+    def histogram(self, name: str, help: str = "",
+                  buckets: tuple[float, ...] | None = None) -> Histogram:
+        existing = self._metrics.get(name)
+        if existing is None:
+            if not _NAME_RE.match(name):
+                raise ValueError(f"bad metric name {name!r} (want "
+                                 f"{_NAME_RE.pattern})")
+            h = Histogram(name, help, buckets)
+            self._metrics[name] = h
+            return h
+        if not isinstance(existing, Histogram):
+            raise ValueError(f"metric {name!r} already registered as "
+                             f"{existing.kind}, not histogram")
+        if buckets is not None and tuple(float(x) for x in
+                                         buckets) != existing.buckets:
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{existing.buckets}, not {tuple(buckets)} (moving "
+                f"buckets between scrapes is unaggregatable)")
+        return existing
+
     def render(self) -> str:
-        """Prometheus text exposition: ``# HELP`` / ``# TYPE`` / value
-        lines, name-sorted for a stable diffable snapshot."""
+        """Prometheus text exposition: ``# HELP`` / ``# TYPE`` lines,
+        then one value line per counter/gauge or the cumulative
+        ``_bucket``/``_sum``/``_count`` series per histogram;
+        name-sorted for a stable diffable snapshot."""
         lines = []
         for name in sorted(self._metrics):
             m = self._metrics[name]
             if m.help:
                 lines.append(f"# HELP {name} {m.help}")
             lines.append(f"# TYPE {name} {m.kind}")
-            lines.append(f"{name} {m.value:g}")
+            if isinstance(m, Histogram):
+                for le, acc in m.cumulative():
+                    lines.append(
+                        f'{name}_bucket{{le="{_fmt_le(le)}"}} {acc}')
+                lines.append(f'{name}_bucket{{le="+Inf"}} {m.count}')
+                lines.append(f"{name}_sum {m.sum:g}")
+                lines.append(f"{name}_count {m.count}")
+            else:
+                lines.append(f"{name} {m.value:g}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def write(self, path: str) -> None:

@@ -7,6 +7,14 @@ timeline and prints the run's post-mortem:
 - header: schema versions, emitting ranks, event count, time span;
 - phase-time table (host wall seconds per run-loop phase, from the
   ``iteration`` spans);
+- span tree (``--trace`` runs): per-phase self/child time from the
+  flight recorder's nested ``span_begin``/``span_end`` extents, torn
+  (crash-open) spans flagged; plus the MEASURED async actor/learner
+  occupancy (``async_overlap_measured``) replacing PR 8's phase-sum
+  projection;
+- clock-skew annotation: with ≥2 sampled ranks the merged timeline is
+  rewritten onto rank 0's corrected monotonic axis (``obs.skew``) and
+  the per-rank offsets/residuals are reported;
 - restart / rollback history: supervisor launch→failure→relaunch
   decisions, watchdog rollbacks, checkpoint save/restore/reject events
   and fault injections, in timeline order;
@@ -28,6 +36,9 @@ import json
 import sys
 
 from .events import merge_dir
+from .skew import correct_events
+from .trace import (SPAN_KINDS, async_overlap_summary, build_span_tree,
+                    to_chrome_trace)
 
 # event kinds that are production alarms (Alarms emissions; ``compile``
 # is the blessed warmup/amnesty record, not an alarm)
@@ -76,11 +87,17 @@ def build_report(events: list[dict]) -> dict:
     for e in events:
         k = str(e.get("kind"))
         counts[k] = counts.get(k, 0) + 1
+    has_spans = any(e.get("kind") in SPAN_KINDS for e in events)
+    span_tree = build_span_tree(events) if has_spans else []
     return {"schema_versions": versions, "ranks": ranks,
             "n_events": len(events), "span_s": span_s, "t0_mono": t0,
             "phase_seconds": phases, "steps_curve": curve,
             "history": history, "ckpt_restores": restores,
-            "chaos": chaos, "alarms": alarms, "kind_counts": counts}
+            "chaos": chaos, "alarms": alarms, "kind_counts": counts,
+            "span_tree": span_tree,
+            "torn_spans": sum(n["open"] for n in span_tree),
+            "async_overlap": (async_overlap_summary(events)
+                              if has_spans else None)}
 
 
 def _fmt_history_line(e: dict, t0: float) -> str:
@@ -110,6 +127,40 @@ def format_report(rep: dict) -> str:
                                   key=lambda kv: -kv[1]):
             lines.append(f"  {phase:<12s} {secs:>10.3f} "
                          f"{100.0 * secs / total:>6.1f}%")
+        lines.append("")
+    if rep.get("span_tree"):
+        lines.append("span tree (flight recorder, self/child time):")
+        lines.append(f"  {'span':<28s} {'count':>6s} {'total s':>10s} "
+                     f"{'self s':>10s}")
+        for n in rep["span_tree"]:
+            label = "  " * n["depth"] + n["name"] + \
+                (f"  [open x{n['open']}]" if n["open"] else "")
+            lines.append(f"  {label:<28s} {n['count']:>6d} "
+                         f"{n['total_s']:>10.3f} {n['self_s']:>10.3f}")
+        if rep.get("torn_spans"):
+            lines.append(f"  ({rep['torn_spans']} torn span(s): begin "
+                         f"with no end — writer died mid-span)")
+        lines.append("")
+    if rep.get("async_overlap"):
+        ov = rep["async_overlap"]
+        lines.append(
+            f"async occupancy (measured from actor/learner spans): "
+            f"async_overlap_measured={ov['async_overlap_measured']:.3f} "
+            f"(window {ov['window_s']:.3f}s, actor busy "
+            f"{ov['actor_busy_s']:.3f}s, learner busy "
+            f"{ov['learner_busy_s']:.3f}s, concurrent "
+            f"{ov['concurrent_s']:.3f}s, idle {ov['idle_s']:.3f}s)")
+        lines.append("")
+    if rep.get("skew", {}).get("applied"):
+        sk = rep["skew"]
+        ranks = ", ".join(
+            f"rank {r}: shift {v['shift_s']*1e3:+.3f}ms "
+            f"(±{v['residual_s']*1e3:.3f}ms, n={v['n_samples']})"
+            for r, v in sk["ranks"].items())
+        lines.append(
+            f"clock skew: timeline rewritten onto rank "
+            f"{sk['reference_rank']}'s monotonic axis — {ranks}; "
+            f"max residual {sk['max_residual_s']*1e3:.3f}ms")
         lines.append("")
     if rep["history"]:
         lines.append("restart / rollback / fault history:")
@@ -167,6 +218,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--out", default=None,
                    help="also write the merged ordered timeline to this "
                         "JSONL file")
+    p.add_argument("--trace-out", default=None,
+                   help="write the timeline as Chrome-trace JSON "
+                        "(open in Perfetto / chrome://tracing)")
+    p.add_argument("--no-skew-correct", action="store_true",
+                   help="keep each rank's raw monotonic axis instead of "
+                        "rewriting onto the learned corrected axis")
     p.add_argument("--strict-alarms", action="store_true",
                    help="exit 1 if any post-warmup alarm event "
                         f"({'/'.join(ALARM_KINDS)}) fired")
@@ -180,11 +237,18 @@ def main(argv: list[str] | None = None) -> int:
         print(f"event streams under {args.obs_dir} hold no decodable "
               f"events", file=sys.stderr)
         return 1
+    skew_info: dict = {"applied": False}
+    if not args.no_skew_correct:
+        events, skew_info = correct_events(events)
     if args.out:
         with open(args.out, "w") as f:
             for e in events:
                 f.write(json.dumps(e, sort_keys=True) + "\n")
+    if args.trace_out:
+        with open(args.trace_out, "w") as f:
+            json.dump(to_chrome_trace(events), f)
     rep = build_report(events)
+    rep["skew"] = skew_info
     if args.json:
         print(json.dumps(rep, sort_keys=True))
     else:

@@ -42,6 +42,7 @@ from ..analysis.sentinels import CompileCounter, no_implicit_transfers
 from ..utils.profiling import SectionTimer
 from .events import EventBus
 from .metrics import Registry
+from .trace import Tracer
 
 PROM_SNAPSHOT = "metrics.prom"
 
@@ -194,12 +195,16 @@ class RunTelemetry:
     def __init__(self, obs_dir: str, rank: int = 0, alarms: bool = False,
                  warmup_iters: int = 1, transfer_guard: bool = True,
                  slow_iter_s: float | None = None,
-                 name: str | None = None,
+                 name: str | None = None, trace: bool = False,
                  clock: Callable[[], float] = time.monotonic):
         self.obs_dir = obs_dir
         self.bus = EventBus(obs_dir, rank=rank, name=name)
         self.registry = Registry()
         self.sections = SectionTimer()
+        # the span-tracing flight recorder (obs.trace): disabled it is a
+        # shared no-op context per span — the run loops thread it
+        # unconditionally, so --trace costs nothing when off
+        self.tracer = Tracer(self.bus, enabled=trace)
         self._clock = clock
         self.alarms = (Alarms(self.bus, self.registry,
                               warmup_iters=warmup_iters,
@@ -216,6 +221,7 @@ class RunTelemetry:
             "cumulative env-steps/sec over the run (monotonic clock)")
         self._t_run = clock()
         self._t_iter: float | None = None
+        self._iter_span: Any = None
         self._last_sections: dict[str, float] = {}
         self.prom_path = os.path.join(obs_dir, PROM_SNAPSHOT)
 
@@ -248,8 +254,19 @@ class RunTelemetry:
     # -- per-iteration protocol -------------------------------------------
     def begin_iteration(self, iteration: int) -> None:
         self._t_iter = self._clock()
+        if self.tracer.enabled:
+            # the per-iteration flight-recorder span: phase spans the
+            # loop opens (step/sync/eval/ckpt) nest under it
+            self._iter_span = self.tracer.span("iteration",
+                                               iteration=iteration)
+            self._iter_span.__enter__()
         if self.alarms is not None:
             self.alarms.maybe_start_profile()
+
+    def _close_iter_span(self) -> None:
+        if self._iter_span is not None:
+            self._iter_span.__exit__(None, None, None)
+            self._iter_span = None
 
     @contextlib.contextmanager
     def dispatch(self, iteration: int) -> Iterator[None]:
@@ -268,6 +285,7 @@ class RunTelemetry:
         wall = (self._clock() - self._t_iter
                 if self._t_iter is not None else 0.0)
         self._t_iter = None
+        self._close_iter_span()
         self._iterations.inc()
         self._env_steps.inc(env_steps)
         dt = self._clock() - self._t_run
@@ -289,6 +307,7 @@ class RunTelemetry:
         without an event (the watchdog emits its own ``rollback``) and
         grant the retry's re-trace amnesty."""
         self._t_iter = None
+        self._close_iter_span()
         if self.alarms is not None:
             self.alarms.stop_profile(iteration)
             self.alarms.expect_recompile(reason)

@@ -169,8 +169,11 @@ def main(argv: list[str] | None = None) -> None:
                                               parse_fault)
 
     bus = None
+    from rlgpuschedule_tpu.obs.trace import NULL_TRACER, Tracer
+    tracer = NULL_TRACER
     if args.obs_dir:
         from rlgpuschedule_tpu.obs import EventBus
+        from rlgpuschedule_tpu.obs import skew as skew_lib
         bus = EventBus(args.obs_dir, rank=args.proc_id)
         bus.emit("worker_start", world=args.num_procs,
                  devices_per_proc=args.devices_per_proc, steps=args.steps,
@@ -178,6 +181,11 @@ def main(argv: list[str] | None = None) -> None:
                               if args.resume_step >= 0 else None),
                  restore_rank=(args.restore_rank
                                if args.restore_rank >= 0 else None))
+        # clock-skew handshake: a dedicated (wall, mono) offset sample at
+        # start and each step, so the report CLI can rewrite all ranks'
+        # timelines onto one corrected monotonic axis
+        skew_lib.stamp(bus, source="worker_start")
+        tracer = Tracer(bus, enabled=True)
     injector = FaultInjector([parse_fault(s) for s in args.fault or []],
                              bus=bus)
     hb = (HeartbeatWriter(args.heartbeat_dir, args.proc_id)
@@ -281,13 +289,20 @@ def main(argv: list[str] | None = None) -> None:
         injector.maybe_exit_rank(args.proc_id, i)
         if hb is not None:
             hb.beat(i)
-        state, carry, metrics = step(state, carry, traces,
-                                     jax.random.PRNGKey(i))
-        if args.ckpt_dir:
-            jax.block_until_ready(state.params)
-            _save_rank_ckpt(args.ckpt_dir, args.proc_id, state, i + 1)
+        # per-rank iteration span (a named ROADMAP residual): every rank
+        # records its own step extent, so the merged skew-corrected
+        # timeline shows the gang's lockstep (or a straggler's lag)
+        with tracer.span("iteration", iteration=i):
+            state, carry, metrics = step(state, carry, traces,
+                                         jax.random.PRNGKey(i))
+            if args.ckpt_dir:
+                jax.block_until_ready(state.params)
+                with tracer.span("ckpt"):
+                    _save_rank_ckpt(args.ckpt_dir, args.proc_id, state,
+                                    i + 1)
         if bus is not None:
             bus.emit("worker_step", step=i, completed=i + 1)
+            skew_lib.stamp(bus, source="step", step=i)
     jax.block_until_ready(state.params)
     assert all(bool(jnp.isfinite(v)) for v in metrics), metrics
     # replicated-params fingerprint: identical across ranks iff the

@@ -24,13 +24,13 @@ one-at-a-time evaluation.
 - ``python -m rlgpuschedule_tpu.serve`` — the CLI (``--bench``,
   ``--fleet N``, ``--metrics-port`` live Prometheus scrape endpoint).
 """
-from .batching import (PolicyServer, ServeResult, next_bucket, pad_batch,
-                       scatter_results, stack_requests)
+from .batching import (PolicyServer, Reservoir, ServeResult, next_bucket,
+                       pad_batch, scatter_results, stack_requests)
 from .engine import InferenceEngine
 from .fleet import fleet_replay, fleet_windows, sample_fleet_faults
 
 __all__ = [
-    "InferenceEngine", "PolicyServer", "ServeResult",
+    "InferenceEngine", "PolicyServer", "Reservoir", "ServeResult",
     "next_bucket", "pad_batch", "scatter_results", "stack_requests",
     "fleet_replay", "fleet_windows", "sample_fleet_faults",
 ]

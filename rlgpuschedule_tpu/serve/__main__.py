@@ -100,6 +100,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--obs-dir", default=None,
                    help="emit serve events (JSONL bus) + a metrics.prom "
                         "snapshot under this directory")
+    p.add_argument("--trace-spans", action="store_true",
+                   help="flight recorder: record the request lifecycle "
+                        "(enqueue/bucket_wait/pad/dispatch/scatter) as "
+                        "nested spans on the event bus; requires "
+                        "--obs-dir (spans ride the JSONL stream). NOT "
+                        "--trace, which picks the workload trace source")
     return p
 
 
@@ -133,6 +139,9 @@ def main(argv: "list[str] | None" = None) -> dict:
         if too_big:
             sys.exit(f"--request-sizes {too_big} exceed --bucket "
                      f"{args.bucket}")
+    if args.trace_spans and not args.obs_dir:
+        sys.exit("--trace-spans records spans on the event bus; pass "
+                 "--obs-dir with it (refusing the silent no-op)")
     if args.fleet_regime is not None:
         from ..sim.faults import FAULT_REGIMES
         if args.fleet_regime not in FAULT_REGIMES:
@@ -154,6 +163,7 @@ def main(argv: "list[str] | None" = None) -> dict:
 
     from ..experiment import Experiment
     from ..obs import EventBus, Registry
+    from ..obs.trace import NULL_TRACER, Tracer
     from ..utils.platform import enable_compile_cache
     from .batching import PolicyServer
     from .bench import build_request_pool, run_bench
@@ -182,6 +192,8 @@ def main(argv: "list[str] | None" = None) -> dict:
     if args.obs_dir:
         bus = EventBus(os.path.abspath(args.obs_dir), rank=0,
                        name="serve")
+    tracer = (Tracer(bus, enabled=True)
+              if args.trace_spans else NULL_TRACER)
     scraper = None
     report: dict = {"repro": repro}
     try:
@@ -192,14 +204,16 @@ def main(argv: "list[str] | None" = None) -> dict:
                   file=sys.stderr)
         engine = InferenceEngine(exp.apply_fn, exp.train_state.params,
                                  exp.env_params, max_bucket=args.bucket,
-                                 registry=registry, bus=bus)
+                                 registry=registry, bus=bus,
+                                 tracer=tracer)
         if args.bench:
             pool = build_request_pool(exp.apply_fn,
                                       exp.train_state.params,
                                       exp.env_params, exp.traces,
                                       steps=args.pool_steps,
                                       faults=exp.faults)
-            server = PolicyServer(engine, registry=registry)
+            server = PolicyServer(engine, registry=registry,
+                                  tracer=tracer)
             report["bench"] = run_bench(engine, server, pool,
                                         rounds=args.rounds,
                                         request_sizes=sizes)

@@ -24,12 +24,57 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import random
 import threading
 import time
 from concurrent.futures import Future
 from typing import Any
 
 import numpy as np
+
+from ..obs.trace import NULL_TRACER
+
+
+class Reservoir:
+    """Bounded uniform sample of an unbounded stream (Vitter's
+    Algorithm R): the first ``capacity`` observations are kept verbatim,
+    after which each new observation replaces a random kept one with
+    probability ``capacity / count``. Memory stays flat forever while
+    every observation ever made has EQUAL probability of being in the
+    sample — unlike a ``deque(maxlen=)`` ring, whose percentiles only
+    describe the last ``capacity`` observations of a long soak run.
+    Seeded so two servers replaying one workload keep identical samples.
+
+    Sequence protocol (``len``/indexing/iteration) so ``np.asarray``
+    and ``np.percentile`` consume it directly; ``count`` is the total
+    number of observations ever offered.
+    """
+
+    def __init__(self, capacity: int, seed: int = 0):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.capacity = int(capacity)
+        self.count = 0
+        self._rng = random.Random(seed)
+        self._samples: list[float] = []
+
+    def append(self, v: float) -> None:
+        self.count += 1
+        if len(self._samples) < self.capacity:
+            self._samples.append(v)
+            return
+        j = self._rng.randrange(self.count)
+        if j < self.capacity:
+            self._samples[j] = v
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def __getitem__(self, i):
+        return self._samples[i]
+
+    def __iter__(self):
+        return iter(self._samples)
 
 
 def next_bucket(n: int, max_bucket: int) -> int:
@@ -123,16 +168,27 @@ class PolicyServer:
     the ``metrics.prom`` snapshot and the live scrape endpoint):
     ``serve_requests_total``, ``serve_dispatches_total``,
     ``serve_queue_depth``, ``serve_batch_occupancy`` (real rows /
-    bucket, last dispatch), ``serve_decision_latency_p50_ms`` / ``_p99_ms``
+    bucket, last dispatch), the ``serve_decision_latency_seconds``
+    histogram (observed per request at scatter — the aggregatable
+    latency surface; scrape-side ``histogram_quantile`` beats exporting
+    pre-computed percentiles), ``serve_latency_sample_window`` (live
+    reservoir size), ``serve_decision_latency_p50_ms`` / ``_p99_ms``
     and ``serve_decisions_per_s`` (+ ``_per_chip``) via
     :meth:`slo_snapshot`.
+
+    With a ``tracer`` attached (``serve --trace-spans``) the request
+    lifecycle lands on the flight recorder: an ``enqueue`` instant per
+    submit, then ``bucket_wait`` -> ``serve_batch`` (``stack`` ->
+    engine ``pad``/``dispatch`` -> ``scatter``) per pump.
     """
 
     def __init__(self, engine, registry=None, latency_window: int = 8192,
-                 clock=time.perf_counter, max_wait_s: float | None = None):
+                 clock=time.perf_counter, max_wait_s: float | None = None,
+                 tracer=None, sample_seed: int = 0):
         from ..obs import Registry
         self.engine = engine
         self.registry = registry if registry is not None else Registry()
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         if max_wait_s is not None and max_wait_s < 0:
             raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
         self.max_wait_s = max_wait_s
@@ -140,10 +196,10 @@ class PolicyServer:
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._pending: collections.deque[_Pending] = collections.deque()
-        self._latencies: collections.deque[float] = collections.deque(
-            maxlen=latency_window)
-        self._occupancies: collections.deque[float] = collections.deque(
-            maxlen=latency_window)
+        # lifetime-uniform reservoirs, not rings: a soak run's p99 must
+        # describe the whole run, not its trailing window
+        self._latencies = Reservoir(latency_window, seed=sample_seed)
+        self._occupancies = Reservoir(latency_window, seed=sample_seed + 1)
         self._thread: threading.Thread | None = None
         self._stopped = False
         self._served = 0
@@ -161,6 +217,14 @@ class PolicyServer:
         self._occupancy = self.registry.gauge(
             "serve_batch_occupancy",
             "real rows / bucket rows of the last dispatch")
+        self._sample_window = self.registry.gauge(
+            "serve_latency_sample_window",
+            "latency samples currently held by the reservoir")
+        self._latency_hist = self.registry.histogram(
+            "serve_decision_latency_seconds",
+            "submit->result decision latency (cumulative histogram; "
+            "aggregatable across ranks/restarts, unlike percentile "
+            "gauges)")
 
     def submit(self, obs: Any, mask: Any, stall: int = 0) -> Future:
         """Enqueue one scheduling request (host pytrees, NO leading batch
@@ -175,6 +239,7 @@ class PolicyServer:
             self._pending.append(req)
             self._requests.inc()
             self._wake.notify()
+        self.tracer.instant("enqueue", stall=int(stall))
         return fut
 
     def pump(self, max_wait_s: float | None = None) -> int:
@@ -196,12 +261,13 @@ class PolicyServer:
         with self._lock:
             if max_wait_s is not None and self._pending:
                 deadline = self._pending[0].t_submit + max_wait_s
-                while (len(self._pending) < self.engine.max_bucket
-                       and not self._stopped):
-                    remaining = deadline - self._clock()
-                    if remaining <= 0:
-                        break
-                    self._wake.wait(timeout=remaining)
+                with self.tracer.span("bucket_wait"):
+                    while (len(self._pending) < self.engine.max_bucket
+                           and not self._stopped):
+                        remaining = deadline - self._clock()
+                        if remaining <= 0:
+                            break
+                        self._wake.wait(timeout=remaining)
             batch = [self._pending.popleft()
                      for _ in range(min(len(self._pending),
                                         self.engine.max_bucket))]
@@ -210,12 +276,15 @@ class PolicyServer:
             return 0
         n = len(batch)
         try:
-            obs = stack_requests([r.obs for r in batch])
-            mask = stack_requests([r.mask for r in batch])
-            stall = np.asarray([r.stall for r in batch], np.int32)
-            actions, bucket = self.engine.decide(obs, mask, stall)
-            now = self._clock()
-            per_req = scatter_results(actions, n)
+            with self.tracer.span("serve_batch", n=n):
+                with self.tracer.span("stack"):
+                    obs = stack_requests([r.obs for r in batch])
+                    mask = stack_requests([r.mask for r in batch])
+                    stall = np.asarray([r.stall for r in batch], np.int32)
+                actions, bucket = self.engine.decide(obs, mask, stall)
+                now = self._clock()
+                with self.tracer.span("scatter"):
+                    per_req = scatter_results(actions, n)
         except BaseException as e:
             for r in batch:
                 if not r.future.cancelled():
@@ -233,7 +302,9 @@ class PolicyServer:
         for r, a in zip(batch, per_req):
             lat = now - r.t_submit
             self._latencies.append(lat)
+            self._latency_hist.observe(lat)
             r.future.set_result(ServeResult(action=a, latency_s=lat))
+        self._sample_window.set(len(self._latencies))
         return n
 
     # ---- live dispatcher thread --------------------------------------

@@ -29,6 +29,7 @@ from ..analysis.sentinels import (CompileCounter, RecompileSentinelError,
                                   no_implicit_transfers)
 from ..decision import (gate_stalled, policy_decision, preempt_slice,
                         stall_threshold)
+from ..obs.trace import NULL_TRACER
 from .batching import next_bucket, pad_batch
 
 
@@ -53,7 +54,8 @@ class InferenceEngine:
 
     def __init__(self, apply_fn, net_params: Any, env_params: Any = None,
                  max_bucket: int = 256, registry=None, bus=None,
-                 strict: bool = False, stall_gate: bool = True):
+                 strict: bool = False, stall_gate: bool = True,
+                 tracer=None):
         from ..obs import Registry
         if max_bucket <= 0 or (max_bucket & (max_bucket - 1)):
             raise ValueError(f"max_bucket must be a positive power of "
@@ -62,6 +64,7 @@ class InferenceEngine:
         self.strict = strict
         self.registry = registry if registry is not None else Registry()
         self._bus = bus
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         # placement resolved from the shared unified mesh (same device
         # walk as train/async) instead of jax's implicit default device:
         # the engine serves from a one-device submesh — the mesh's first
@@ -173,19 +176,21 @@ class InferenceEngine:
         host, bucket)``."""
         n = int(jax.tree.leaves(obs)[0].shape[0])
         bucket = self.bucket_for(n)
-        obs_p = pad_batch(obs, bucket)
-        mask_p = pad_batch(mask, bucket, fill_mask_true=True)
-        if stall is None:
-            stall = np.zeros(n, np.int32)
-        stall_p = pad_batch(np.asarray(stall, np.int32), bucket)
-        # explicit upload: the one host->device transfer serving performs,
-        # outside the transfer-guarded dispatch by design
-        obs_d = jax.device_put(obs_p, self._serve_sharding)
-        mask_d = jax.device_put(mask_p, self._serve_sharding)
-        stall_d = (jax.device_put(stall_p, self._serve_sharding)
-                   if self._has_stall_gate else None)
-        out = self._dispatch(obs_d, mask_d, stall_d, bucket)
-        actions = jax.device_get(out)       # explicit download, ditto
+        with self.tracer.span("pad", n=n, bucket=bucket):
+            obs_p = pad_batch(obs, bucket)
+            mask_p = pad_batch(mask, bucket, fill_mask_true=True)
+            if stall is None:
+                stall = np.zeros(n, np.int32)
+            stall_p = pad_batch(np.asarray(stall, np.int32), bucket)
+            # explicit upload: the one host->device transfer serving
+            # performs, outside the transfer-guarded dispatch by design
+            obs_d = jax.device_put(obs_p, self._serve_sharding)
+            mask_d = jax.device_put(mask_p, self._serve_sharding)
+            stall_d = (jax.device_put(stall_p, self._serve_sharding)
+                       if self._has_stall_gate else None)
+        with self.tracer.span("dispatch", bucket=bucket):
+            out = self._dispatch(obs_d, mask_d, stall_d, bucket)
+            actions = jax.device_get(out)   # explicit download, ditto
         return jax.tree.map(lambda a: a[:n], actions), bucket
 
     def warmup(self, example_obs: Any, example_mask: Any,

@@ -220,6 +220,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "emits a slow_iteration event and auto-captures "
                         "a one-shot jax.profiler trace of the NEXT "
                         "iteration under <obs-dir>/profile")
+    p.add_argument("--trace-spans", action="store_true",
+                   help="flight recorder (requires --obs-dir): record "
+                        "nested phase spans (iteration/step/sync/... and "
+                        "the async engine's actor/learner/queue-wait "
+                        "lanes) on the event bus; export with "
+                        "obs.report --trace-out trace.json (Perfetto). "
+                        "NOT --trace, which picks the workload trace "
+                        "source")
     p.add_argument("--debug-nans", action="store_true",
                    help="run under jax_debug_nans (sanitizer hook — the "
                         "functional design has no data races to detect, so "
@@ -504,6 +512,9 @@ def main(argv: list[str] | None = None) -> dict:
     if args.alarms and not args.obs_dir:
         sys.exit("--alarms requires --obs-dir (alarm events need an "
                  "event stream to land in)")
+    if args.trace_spans and not args.obs_dir:
+        sys.exit("--trace-spans requires --obs-dir (span events need an "
+                 "event stream to land in)")
     if args.alarm_slow_iter is not None:
         if not args.alarms:
             sys.exit("--alarm-slow-iter is an alarm trigger; pass "
@@ -553,7 +564,8 @@ def main(argv: list[str] | None = None) -> dict:
             from .obs import RunTelemetry
             telemetry = stack.enter_context(RunTelemetry(
                 os.path.abspath(args.obs_dir), rank=0,
-                alarms=args.alarms, slow_iter_s=args.alarm_slow_iter))
+                alarms=args.alarms, slow_iter_s=args.alarm_slow_iter,
+                trace=args.trace_spans))
             bus = telemetry.bus
         ckpt = None
         if args.ckpt_dir:

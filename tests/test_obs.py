@@ -294,7 +294,9 @@ class TestRunTelemetry:
         """The zero-added-host-syncs contract: an instrumented run calls
         jax.device_get exactly once per logged iteration — the same
         single batched sync the bare loop pays (jsan host-sync review,
-        PR 3)."""
+        PR 3). Runs with the flight recorder ON (trace=True): span
+        emission must touch host clocks and the JSONL file only, never
+        a device value — the --trace-spans acceptance gate."""
         from rlgpuschedule_tpu.experiment import Experiment
         exp = Experiment.build(SMALL)
         calls = {"n": 0}
@@ -304,11 +306,16 @@ class TestRunTelemetry:
             calls["n"] += 1
             return real(x)
 
-        with RunTelemetry(str(tmp_path), rank=0, alarms=True) as tel:
+        with RunTelemetry(str(tmp_path), rank=0, alarms=True,
+                          trace=True) as tel:
             monkeypatch.setattr(jax, "device_get", counting)
             exp.run(iterations=3, log_every=1, telemetry=tel)
             monkeypatch.setattr(jax, "device_get", real)
         assert calls["n"] == 3   # one per logged iteration, none extra
+        # and the spans actually landed (tracing was really on)
+        from rlgpuschedule_tpu.obs.trace import SPAN_BEGIN
+        events = read_events(tel.bus.path)
+        assert any(e["kind"] == SPAN_BEGIN for e in events)
 
     def test_rollback_story_lands_on_one_timeline(self, tmp_path):
         """fault -> ckpt_restore -> rollback -> amnestied compile on the
