@@ -118,7 +118,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "platform; reports measured speedup plus the "
                         "phase-time overlap ceiling)")
     p.add_argument("--staleness-bound", type=int, default=1,
-                   help="staleness bound for the --async measurement")
+                   help="staleness bound for the --async measurement; "
+                        "bounds >= 4 want --correction vtrace")
+    p.add_argument("--correction", default="none",
+                   choices=["none", "vtrace"],
+                   help="with --async: advantage correction for the "
+                        "benched engine — 'vtrace' benches the "
+                        "importance-corrected deep-staleness pipeline "
+                        "(its batched ratio recompute is part of the "
+                        "learner phase being measured)")
     return p
 
 
@@ -147,10 +155,19 @@ def bench_async(cfg, args, platform: str, iters: int) -> None:
     two loops in parallel (the 1-core CI rig — and XLA:CPU additionally
     forces serialized dispatch, see async_engine), the measured ratio
     reads ~1.0 and the projection is the honest overlap ceiling."""
+    import tempfile
+
     import jax
     from rlgpuschedule_tpu.async_engine import AsyncRunner
     from rlgpuschedule_tpu.experiment import Experiment
 
+    if args.correction != "none":
+        # the deep-staleness pipeline: importance-corrected advantage
+        # targets (algos.vtrace) — sync comparator stays uncorrected
+        # (the sync loop is on-policy; ratios would be identically 1)
+        cfg = dataclasses.replace(
+            cfg, ppo=dataclasses.replace(cfg.ppo,
+                                         correction=args.correction))
     n_chips = jax.device_count()
 
     def rate(run, k: int) -> tuple[float, float]:
@@ -159,7 +176,10 @@ def bench_async(cfg, args, platform: str, iters: int) -> None:
         wall = time.perf_counter() - t0
         return wall, k * steps_iter / wall / n_chips
 
-    exp_s = Experiment.build(cfg)
+    sync_cfg = (dataclasses.replace(
+        cfg, ppo=dataclasses.replace(cfg.ppo, correction="none"))
+        if args.correction != "none" else cfg)
+    exp_s = Experiment.build(sync_cfg)
     steps_iter = exp_s.steps_per_iteration
     exp_s.run(iterations=iters)                       # compile + warmup
     cal = min(rate(lambda k: exp_s.run(iterations=k), iters)[0]
@@ -168,7 +188,8 @@ def bench_async(cfg, args, platform: str, iters: int) -> None:
     iters_rep = max(iters, min(2_000, int(iters * target_s / max(cal, 1e-6))))
 
     exp_a = Experiment.build(cfg)
-    runner = AsyncRunner(exp_a, staleness_bound=args.staleness_bound)
+    runner = AsyncRunner(exp_a, staleness_bound=args.staleness_bound,
+                         queue_capacity=max(2, args.staleness_bound))
     runner.run(iterations=iters)                      # compile + warmup
 
     repeats = 5
@@ -177,6 +198,21 @@ def bench_async(cfg, args, platform: str, iters: int) -> None:
     async_r = sorted(rate(lambda k: runner.run(iterations=k), iters_rep)[1]
                      for _ in range(repeats))
     sync_v, async_v = sync_r[repeats // 2], async_r[repeats // 2]
+    # measured occupancy (PR 11's flight recorder): ONE extra traced
+    # repeat, untimed — span emission is file IO per iteration, so it
+    # stays out of the throughput repeats above. log_every materializes
+    # the importance-ratio stats the correction pipeline reports (the
+    # timed repeats never sync metrics, so rho would read its 1.0
+    # neutral default otherwise)
+    from rlgpuschedule_tpu.obs import RunTelemetry
+    from rlgpuschedule_tpu.obs.events import read_events
+    from rlgpuschedule_tpu.obs.trace import async_overlap_summary
+    with tempfile.TemporaryDirectory() as td:
+        with RunTelemetry(td, trace=True) as tel:
+            runner.run(iterations=min(iters_rep, 200), log_every=10,
+                       logger=lambda i, m: None, telemetry=tel)
+            events_path = tel.bus.path
+        overlap = async_overlap_summary(read_events(events_path))
     info = runner.async_info()
     r_busy, u_busy = info["actor_busy_s"], info["learner_busy_s"]
     ceiling = ((r_busy + u_busy) / max(r_busy, u_busy)
@@ -185,6 +221,7 @@ def bench_async(cfg, args, platform: str, iters: int) -> None:
         "metric": f"async_actor_learner_speedup[{platform}]",
         "method": "sync-iter-loop-vs-async-engine",
         "staleness_bound": args.staleness_bound,
+        "correction": args.correction,
         "groups": runner.groups.describe(),
         "cores": os.cpu_count(),
         "iters_per_repeat": iters_rep,
@@ -196,12 +233,19 @@ def bench_async(cfg, args, platform: str, iters: int) -> None:
         "learner_busy_s": round(u_busy, 3),
         "projected_overlap_speedup":
             round(ceiling, 3) if ceiling else None,
+        "async_overlap_measured": (overlap["async_overlap_measured"]
+                                   if overlap else None),
+        "overlap_window": overlap,
         "overlap_s": round(info["overlap_s"], 3),
         "staleness_max": info["staleness_max"],
+        "importance_ratio_mean": info["importance_ratio_mean"],
+        "importance_ratio_max": info["importance_ratio_max"],
         "note": ("projected_overlap_speedup is the phase-time ceiling "
-                 "(R+U)/max(R,U); the measured speedup needs enough "
-                 "host cores to run both loops concurrently, and on "
-                 "XLA:CPU the engine serializes device dispatch"),
+                 "(R+U)/max(R,U); async_overlap_measured is the span-"
+                 "timeline occupancy of one traced repeat (1 - idle/"
+                 "window). The measured speedup needs enough host cores "
+                 "to run both loops concurrently, and on XLA:CPU the "
+                 "engine serializes device dispatch"),
     }))
 
 

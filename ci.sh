@@ -53,12 +53,13 @@ echo "=== smoke: observability (3-iter CPU run + merged-timeline report) ==="
 # in one exit code (ISSUE 5 acceptance).
 OBS_DIR=$(mktemp -d /tmp/ci_obs.XXXXXX)
 ASYNC_OBS_DIR=$(mktemp -d /tmp/ci_async_obs.XXXXXX)
+VTRACE_OBS_DIR=$(mktemp -d /tmp/ci_vtrace_obs.XXXXXX)
 SERVE_OBS_DIR=$(mktemp -d /tmp/ci_serve_obs.XXXXXX)
 CHAOS_JSON=$(mktemp /tmp/ci_chaos.XXXXXX.json)
 SERVE_JSON=$(mktemp /tmp/ci_serve.XXXXXX.json)
 TRACE_JSON=$(mktemp /tmp/ci_trace.XXXXXX.json)
-trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$SERVE_OBS_DIR" "$CHAOS_JSON" \
-    "$SERVE_JSON" "$TRACE_JSON"' EXIT
+trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$VTRACE_OBS_DIR" \
+    "$SERVE_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON" "$TRACE_JSON"' EXIT
 # --trace-spans rides along (ISSUE 11): the flight recorder must not
 # disturb the strict-alarms gate, and the exported Chrome trace must be
 # Perfetto-valid (validated per layer below)
@@ -146,6 +147,40 @@ print("async smoke ok:", {"actor_s": round(ph["actor"], 3),
                           "staleness_max": end["async_staleness_max"]})
 EOF
 
+echo "=== smoke: deep-staleness V-trace (bound=4 overlapped run, 2 CPU devices) ==="
+# ISSUE 12 acceptance: the off-policy-corrected engine must run the
+# trajectory queue DEEP (staleness bound 4) under the same strict-alarms
+# gate as the bound-1 smoke, and the run_end event must carry the
+# importance-ratio gauge pair with the staleness counter above 1 — proof
+# the V-trace ratio recompute executed against genuinely stale batches
+# (the gauges feed from logged metrics, hence --log-every 1).
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python -m rlgpuschedule_tpu.train --config ppo-mlp-synth64 \
+    --async --staleness-bound 4 --correction vtrace \
+    --iterations 6 --n-envs 4 --n-nodes 2 --gpus-per-node 4 \
+    --window-jobs 16 --horizon 64 --queue-len 4 --n-steps 8 \
+    --n-epochs 1 --n-minibatches 2 --log-every 1 \
+    --obs-dir "$VTRACE_OBS_DIR" --alarms > /dev/null
+timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.obs.report "$VTRACE_OBS_DIR" \
+    --strict-alarms > /dev/null
+python - "$VTRACE_OBS_DIR" <<'EOF'
+import math, sys
+from rlgpuschedule_tpu.obs import merge_dir
+events = merge_dir(sys.argv[1])
+end = next(e for e in events if e["kind"] == "run_end")
+for k in ("async_importance_ratio_mean", "async_importance_ratio_max"):
+    assert k in end and math.isfinite(end[k]) and end[k] > 0, \
+        (k, end.get(k))
+assert end["async_staleness_max"] >= 1, end["async_staleness_max"]
+assert not [e for e in events if e["kind"] == "recompile"], "recompiles"
+print("vtrace smoke ok:", {
+    "rho_mean": round(end["async_importance_ratio_mean"], 4),
+    "rho_max": round(end["async_importance_ratio_max"], 4),
+    "staleness_max": end["async_staleness_max"]})
+EOF
+
 echo "=== smoke: chaos matrix (2 regimes x policy+SJF, CPU) ==="
 # ISSUE 6 acceptance: a tiny evaluate --chaos matrix must exit 0, keep
 # the no-jobs-lost conservation contract, and carry per-regime
@@ -226,8 +261,8 @@ MESH_OBS_DIR=$(mktemp -d /tmp/ci_mesh_obs.XXXXXX)
 PBT_OBS_DIR=$(mktemp -d /tmp/ci_pbt_obs.XXXXXX)
 MESH_JSON=$(mktemp /tmp/ci_mesh.XXXXXX.json)
 PBT_JSON=$(mktemp /tmp/ci_pbt.XXXXXX.json)
-trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$SERVE_OBS_DIR" "$CHAOS_JSON" \
-    "$SERVE_JSON" "$TRACE_JSON" \
+trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$VTRACE_OBS_DIR" \
+    "$SERVE_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON" "$TRACE_JSON" \
     "$MESH_OBS_DIR" "$PBT_OBS_DIR" "$MESH_JSON" "$PBT_JSON"' EXIT
 # JAX_ENABLE_COMPILATION_CACHE=false on BOTH mesh trains: the persistent
 # compile cache flakily heap-corrupts (malloc_consolidate / segfault,

@@ -7,9 +7,11 @@ from .update import (resolve_geometry, validate_update_geometry,
                      run_minibatch_epochs, make_update_step, cast_floating)
 from .ppo import (PPOConfig, PPOMetrics, make_train_step as make_ppo_step,
                   make_learn_step as make_ppo_learn_step,
-                  make_train_state, ppo_loss, masked_entropy)
+                  make_train_state, ppo_loss, masked_entropy,
+                  compute_advantages, NormTrainState, RewardNormState)
 from .a2c import (A2CConfig, A2CMetrics, make_train_step as make_a2c_step,
                   make_learn_step as make_a2c_learn_step)
+from .vtrace import compute_vtrace, importance_ratios
 from . import action_dist
 
 __all__ = [
@@ -19,6 +21,8 @@ __all__ = [
     "make_update_step", "cast_floating",
     "PPOConfig", "PPOMetrics", "make_ppo_step", "make_ppo_learn_step",
     "make_train_state", "ppo_loss", "masked_entropy",
+    "compute_advantages", "NormTrainState", "RewardNormState",
     "A2CConfig", "A2CMetrics", "make_a2c_step", "make_a2c_learn_step",
+    "compute_vtrace", "importance_ratios",
     "action_dist",
 ]

@@ -24,6 +24,7 @@ from flax.training.train_state import TrainState
 from ..env.env import EnvParams
 from ..ops.gae import compute_gae
 from . import action_dist
+from . import ppo as ppo_norm  # shared RewardNormState/Welford helpers
 from . import update as update_engine
 from .rollout import PolicyApply, RolloutCarry, Transition, rollout
 
@@ -39,6 +40,12 @@ class A2CConfig:
     n_minibatches: int = 1
     minibatch_size: int | None = None
     bf16_update: bool = False   # same contract as PPOConfig.bf16_update
+    # fused advantage-pipeline passthrough (same contracts as PPOConfig;
+    # A2C has NO correction field — V-trace's clipped-ratio targets are
+    # a surrogate-objective correction, and the async engine refuses
+    # a2c×vtrace loudly):
+    reward_norm: bool = False
+    bf16_advantages: bool = False
     gamma: float = 0.995
     gae_lambda: float = 1.0     # plain n-step advantage by default
     vf_coef: float = 0.5
@@ -136,9 +143,18 @@ def make_learn_step(apply_fn: PolicyApply, config: A2CConfig,
 
     def learn_step(train_state: TrainState, tr: Transition,
                    last_value: jax.Array, key: jax.Array):
-        advantages, returns = compute_gae(tr.reward, tr.value, tr.done,
+        rewards = tr.reward
+        if config.reward_norm:
+            stats = ppo_norm.update_reward_stats(
+                train_state.reward_stats, rewards, axis_name)
+            rewards = rewards * ppo_norm.reward_scale(stats)
+            train_state = train_state.replace(reward_stats=stats)
+        advantages, returns = compute_gae(rewards, tr.value, tr.done,
                                           last_value, config.gamma,
                                           config.gae_lambda)
+        if config.bf16_advantages:
+            advantages = advantages.astype(jnp.bfloat16)
+            returns = returns.astype(jnp.bfloat16)
         return run_a2c_update(apply_fn, config, train_state, tr,
                               advantages, returns, key, apply_grads)
 

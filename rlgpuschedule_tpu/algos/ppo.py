@@ -25,6 +25,7 @@ from ..env.env import EnvParams
 from ..ops.gae import compute_gae
 from . import action_dist
 from . import update as update_engine
+from . import vtrace as vtrace_ops
 from .rollout import PolicyApply, RolloutCarry, Transition, rollout
 
 
@@ -45,6 +46,25 @@ class PPOConfig:
     # fp32. The encoders already run bf16 activations; this extends the
     # low precision to the update-path params/grads.
     bf16_update: bool = False
+    # off-policy correction for the advantage targets: "none" = GAE on
+    # the behavior values (the on-policy path), "vtrace" = IMPALA-style
+    # importance-weighted targets (algos.vtrace) against the learner's
+    # CURRENT value function — required for deep async staleness bounds,
+    # pure overhead when the data is on-policy (ratios ≡ 1 reduces it
+    # bit-identically to GAE, so bound-0 async runs stay bitwise equal
+    # to sync).
+    correction: str = "none"
+    rho_bar: float = 1.0       # V-trace TD-error weight clip ρ̄
+    c_bar: float = 1.0         # V-trace trace-coefficient clip c̄
+    # streaming reward standardization (HEPPO-style): scale rewards by
+    # 1/√(running variance) with Welford stats carried in the train
+    # state (NormTrainState). Scale-only — no centering, which would
+    # change the optimal policy under episodic returns.
+    reward_norm: bool = False
+    # store normalized advantages/returns in bf16 through the
+    # epoch×minibatch engine (HEPPO's compressed-advantage pipeline).
+    # NOT bit-identical — opt-in, rides the bf16_update seam.
+    bf16_advantages: bool = False
     gamma: float = 0.995
     gae_lambda: float = 0.95
     clip_eps: float = 0.2
@@ -52,6 +72,12 @@ class PPOConfig:
     ent_coef: float = 0.01
     lr: float = 3e-4
     max_grad_norm: float = 0.5
+
+    def __post_init__(self):
+        if self.correction not in ("none", "vtrace"):
+            raise ValueError(
+                f"PPOConfig.correction must be 'none' or 'vtrace', "
+                f"got {self.correction!r}")
 
 
 def make_optimizer(config: PPOConfig) -> optax.GradientTransformation:
@@ -74,6 +100,64 @@ class PPOMetrics(NamedTuple):
     clip_frac: jax.Array
     mean_reward: jax.Array
     mean_value: jax.Array
+    # unclipped importance-ratio stats from the advantage pipeline —
+    # constant 1.0 on the GAE path, the off-policyness monitor under
+    # correction="vtrace" (surfaced as async gauges / run_end fields).
+    rho_mean: jax.Array
+    rho_max: jax.Array
+
+
+class RewardNormState(NamedTuple):
+    """Welford running moments of the raw reward stream (fp32 scalars),
+    carried in :class:`NormTrainState` when ``reward_norm`` is on."""
+    count: jax.Array
+    mean: jax.Array
+    m2: jax.Array
+
+
+def init_reward_stats() -> RewardNormState:
+    # three DISTINCT buffers: aliasing one zeros array across the fields
+    # trips XLA's double-donation check once the state is donated
+    return RewardNormState(count=jnp.zeros((), jnp.float32),
+                           mean=jnp.zeros((), jnp.float32),
+                           m2=jnp.zeros((), jnp.float32))
+
+
+def update_reward_stats(stats: RewardNormState, rewards: jax.Array,
+                        axis_name: str | None = None) -> RewardNormState:
+    """Streaming (Chan/Welford parallel-combine) update from one rollout
+    batch. Batch moments are globally reduced across the mesh axis so DP
+    replicas carry identical statistics."""
+    r = rewards.astype(jnp.float32)
+    batch_count = jnp.asarray(r.size, jnp.float32)
+    batch_mean = jnp.mean(r)
+    batch_sq = jnp.mean(r * r)
+    if axis_name is not None:
+        batch_count = jax.lax.psum(batch_count, axis_name)
+        batch_mean = jax.lax.pmean(batch_mean, axis_name)
+        batch_sq = jax.lax.pmean(batch_sq, axis_name)
+    batch_m2 = (batch_sq - batch_mean ** 2) * batch_count
+    total = stats.count + batch_count
+    delta = batch_mean - stats.mean
+    new_mean = stats.mean + delta * batch_count / total
+    new_m2 = (stats.m2 + batch_m2
+              + delta ** 2 * stats.count * batch_count / total)
+    return RewardNormState(count=total, mean=new_mean, m2=new_m2)
+
+
+def reward_scale(stats: RewardNormState) -> jax.Array:
+    """1/√(running variance + ε). Scale-only normalization — rewards are
+    NOT centered (subtracting a baseline from per-step rewards changes
+    the optimal policy; rescaling does not)."""
+    var = stats.m2 / jnp.maximum(stats.count, 1.0)
+    return jax.lax.rsqrt(var + 1e-8)
+
+
+class NormTrainState(TrainState):
+    """TrainState + streaming reward moments. Only built when
+    ``reward_norm`` is on, so default checkpoints/pytrees are
+    unchanged."""
+    reward_stats: RewardNormState = None
 
 
 def ppo_loss(apply_fn: PolicyApply, net_params, batch: Transition,
@@ -119,6 +203,61 @@ def normalize_advantages(advantages: jax.Array,
     return (advantages - adv_mean) / jnp.sqrt(adv_var + 1e-8)
 
 
+def compute_advantages(apply_fn: PolicyApply, config: PPOConfig, state,
+                       tr: Transition, last_value: jax.Array,
+                       axis_name: str | None = None):
+    """The fused advantage pipeline (HEPPO-style): streaming reward
+    standardization → GAE or V-trace → global normalization → optional
+    bf16 storage, all inside the caller's jitted/donated update dispatch
+    so none of it runs as a separate fp32 pass.
+
+    Returns ``(state, advantages, returns, rho_stats)`` where
+    ``rho_stats`` is ``(mean, max)`` of the *unclipped* importance
+    ratios under ``correction="vtrace"`` and ``None`` on the GAE path.
+    With the default config this emits exactly the historical
+    ``compute_gae`` + ``normalize_advantages`` ops — bit-identical to
+    the pre-fusion path. ``state`` is any struct with ``.params``
+    (TrainState or the population's MemberState); it is only replaced
+    when ``reward_norm`` updates the Welford stats."""
+    rewards = tr.reward
+    if config.reward_norm:
+        stats = update_reward_stats(state.reward_stats, rewards, axis_name)
+        rewards = rewards * reward_scale(stats)
+        state = state.replace(reward_stats=stats)
+    rho_stats = None
+    if config.correction == "vtrace":
+        T, E = tr.reward.shape[:2]
+        B = T * E
+        flat = lambda x: x.reshape(B, *x.shape[2:])
+        # One batched apply under the learner's current params. The
+        # [T·E] logits (and the log-softmax behind log_prob) are bitwise
+        # row-equal to the rollout's per-step [E] applies on the tested
+        # backends, so on-policy data yields target_lp == tr.log_prob
+        # exactly and ratios ≡ 1.0 exactly. The value HEAD does not share
+        # that property (its [B,1] gemm reassociates with batch size), so
+        # V-trace bootstraps the stored behavior values like GAE does —
+        # the sample-factory/APPO convention, and the choice that keeps
+        # the bound-0 path bit-identical.
+        logits, _ = apply_fn(_params_of(state), flat(tr.obs),
+                             flat(tr.mask))
+        target_lp = action_dist.log_prob(
+            logits, flat(tr.action)).reshape(T, E)
+        rho = vtrace_ops.importance_ratios(tr.log_prob, target_lp)
+        advantages, returns = vtrace_ops.compute_vtrace(
+            rewards, tr.value, tr.done, last_value, rho,
+            config.gamma, config.gae_lambda, config.rho_bar, config.c_bar)
+        rho_stats = (jnp.mean(rho), jnp.max(rho))
+    else:
+        advantages, returns = compute_gae(rewards, tr.value, tr.done,
+                                          last_value, config.gamma,
+                                          config.gae_lambda)
+    advantages = normalize_advantages(advantages, axis_name)
+    if config.bf16_advantages:
+        advantages = advantages.astype(jnp.bfloat16)
+        returns = returns.astype(jnp.bfloat16)
+    return state, advantages, returns, rho_stats
+
+
 def make_ppo_grad_step(apply_fn: PolicyApply, config: PPOConfig,
                        apply_grads, clip_eps=None, ent_coef=None):
     """One clipped-surrogate minibatch update for the fused engine:
@@ -154,7 +293,7 @@ def make_ppo_grad_step(apply_fn: PolicyApply, config: PPOConfig,
 def run_ppo_epochs(apply_fn: PolicyApply, config: PPOConfig, state,
                    tr: Transition, advantages: jax.Array,
                    returns: jax.Array, key: jax.Array, apply_grads,
-                   clip_eps=None, ent_coef=None):
+                   clip_eps=None, ent_coef=None, rho_stats=None):
     """The PPO update core shared by the single-run trainer and the PBT
     member step: flatten [T, E] → [B], then hand the batch to the fused
     minibatch-geometry engine (:mod:`algos.update`) at the config's
@@ -171,11 +310,15 @@ def run_ppo_epochs(apply_fn: PolicyApply, config: PPOConfig, state,
         grad_step, state, (flat, advantages.reshape(B), returns.reshape(B)),
         key, n_epochs=config.n_epochs, n_minibatches=config.n_minibatches,
         minibatch_size=config.minibatch_size)
+    rho_mean, rho_max = (rho_stats if rho_stats is not None
+                         else (jnp.asarray(1.0, jnp.float32),
+                               jnp.asarray(1.0, jnp.float32)))
     metrics = PPOMetrics(
         total_loss=jnp.mean(stats[0]), pg_loss=jnp.mean(stats[1]),
         v_loss=jnp.mean(stats[2]), entropy=jnp.mean(stats[3]),
         approx_kl=jnp.mean(stats[4]), clip_frac=jnp.mean(stats[5]),
-        mean_reward=jnp.mean(tr.reward), mean_value=jnp.mean(tr.value))
+        mean_reward=jnp.mean(tr.reward), mean_value=jnp.mean(tr.value),
+        rho_mean=rho_mean, rho_max=rho_max)
     return state, metrics
 
 
@@ -202,12 +345,11 @@ def make_learn_step(apply_fn: PolicyApply, config: PPOConfig,
 
     def learn_step(train_state: TrainState, tr: Transition,
                    last_value: jax.Array, key: jax.Array):
-        advantages, returns = compute_gae(tr.reward, tr.value, tr.done,
-                                          last_value, config.gamma,
-                                          config.gae_lambda)
-        advantages = normalize_advantages(advantages, axis_name)
+        train_state, advantages, returns, rho_stats = compute_advantages(
+            apply_fn, config, train_state, tr, last_value, axis_name)
         return run_ppo_epochs(apply_fn, config, train_state, tr,
-                              advantages, returns, key, apply_grads)
+                              advantages, returns, key, apply_grads,
+                              rho_stats=rho_stats)
 
     return learn_step
 
@@ -235,8 +377,16 @@ def make_train_step(apply_fn: PolicyApply, env_params: EnvParams,
 def make_train_state(net, key: jax.Array, example_obs: jax.Array,
                      example_mask: jax.Array,
                      tx: optax.GradientTransformation,
-                     extra_apply_args: tuple = ()) -> TrainState:
+                     extra_apply_args: tuple = (),
+                     reward_norm: bool = False) -> TrainState:
     """Initialize params + optimizer into a flax TrainState.
-    ``extra_apply_args`` go between obs and mask (the GNN's adjacency)."""
+    ``extra_apply_args`` go between obs and mask (the GNN's adjacency).
+    ``reward_norm`` swaps in :class:`NormTrainState` carrying the
+    streaming reward moments (different pytree — checkpoints are not
+    interchangeable with the default state, by design)."""
     params = net.init(key, example_obs, *extra_apply_args, example_mask)
+    if reward_norm:
+        return NormTrainState.create(apply_fn=net.apply, params=params,
+                                     tx=tx,
+                                     reward_stats=init_reward_stats())
     return TrainState.create(apply_fn=net.apply, params=params, tx=tx)

@@ -28,7 +28,10 @@ class Transition(NamedTuple):
     """One scan slice of the rollout buffer; stacked to [T, E, ...].
     ``obs``/``action``/``mask`` are arrays for single-head policies and
     pytrees for multi-head (hierarchical) ones; ``log_prob`` is always the
-    joint [E] log-prob."""
+    joint [E] log-prob under the BEHAVIOR params the rollout ran with —
+    PPO's surrogate ratio and V-trace's importance ratios
+    (``algos.vtrace``) both divide the target policy by exactly this
+    stored quantity, so it must never be recomputed post-hoc."""
     obs: Any
     action: Any
     log_prob: jax.Array

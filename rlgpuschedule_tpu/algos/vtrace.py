@@ -1,0 +1,75 @@
+"""V-trace off-policy correction as a reverse lax.scan (L4 op).
+
+IMPALA-style importance-weighted value targets (Espeholt et al., the
+Sebulba/Podracer lineage — PAPERS.md) in the λ-generalized form, so the
+async trajectory queue (:mod:`~rlgpuschedule_tpu.async_engine`) can run
+deep staleness bounds without the bias PPO's clip alone cannot remove.
+
+Shape contract mirrors :func:`ops.gae.compute_gae` exactly — [T, ...]
+time-major inputs, one reverse scan, returns ``(advantages, returns)``.
+The advantage handed to the surrogate loss is ``vs_t − V_t`` (the
+λ-discounted importance-weighted TD accumulation), NOT the canonical
+IMPALA policy-gradient advantage ``ρ_t (r_t + γ vs_{t+1} − V_t)`` —
+the accumulated form is what reduces to GAE when the data is on-policy.
+
+**On-policy bit-identity contract:** with ``rho ≡ 1`` (behavior params
+== target params, so the recomputed log-probs are bitwise equal and
+``exp(0) == 1.0`` exactly), every extra multiply below is by the IEEE
+identity 1.0 and the scan body collapses bitwise to the GAE body:
+``delta = 1.0 * (r + γ·v̂·nt − v)`` and the left-to-right product
+``((γλ)·nt)·1.0·acc ≡ ((γλ)·nt)·acc``. ``staleness_bound=0`` async runs
+with ``correction="vtrace"`` therefore reproduce the sync GAE path bit
+for bit (tests/test_vtrace.py pins this end to end).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def importance_ratios(behavior_log_prob: jax.Array,
+                      target_log_prob: jax.Array) -> jax.Array:
+    """π_target(a|s) / π_behavior(a|s) from joint action log-probs.
+
+    On-policy (bitwise-equal log-probs) the difference is exactly 0.0
+    and the ratio exactly 1.0 — the premise of the bit-identity
+    contract above."""
+    return jnp.exp(target_log_prob - behavior_log_prob)
+
+
+def compute_vtrace(rewards: jax.Array, values: jax.Array,
+                   dones: jax.Array, last_value: jax.Array,
+                   rho: jax.Array, gamma: float, lam: float,
+                   rho_bar: float = 1.0, c_bar: float = 1.0,
+                   ) -> tuple[jax.Array, jax.Array]:
+    """Returns (advantages, returns), each [T, ...].
+
+    Args:
+      rewards: [T, ...] reward at each step.
+      values:  [T, ...] value estimate of the state the action was taken in.
+      dones:   [T, ...] episode ended AT this step (auto-reset envs: the
+               next state belongs to a fresh episode — no bootstrap across).
+      last_value: [...] value of the state after the final step.
+      rho: [T, ...] unclipped importance ratios π_target/π_behavior for
+           the taken actions (:func:`importance_ratios`).
+      rho_bar: clip on the TD-error weight ρ_t = min(ρ̄, ratio) — bounds
+               the fixed point the targets converge to.
+      c_bar:   clip on the trace coefficient c_t = λ·min(c̄, ratio) —
+               bounds how far corrections propagate backwards (variance).
+    """
+    rho_clipped = jnp.minimum(rho, rho_bar)
+    c_clipped = jnp.minimum(rho, c_bar)
+
+    def step(next_acc_and_v, x):
+        next_acc, next_v = next_acc_and_v
+        r, v, d, rh, c = x
+        nonterm = 1.0 - d
+        delta = rh * (r + gamma * next_v * nonterm - v)
+        acc = delta + gamma * lam * nonterm * c * next_acc
+        return (acc, v), acc
+
+    (_, _), advantages = jax.lax.scan(
+        step, (jnp.zeros_like(last_value), last_value),
+        (rewards, values, dones.astype(rewards.dtype),
+         rho_clipped, c_clipped), reverse=True)
+    return advantages, advantages + values

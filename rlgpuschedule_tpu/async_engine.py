@@ -68,6 +68,20 @@ serializes device dispatch behind a lock and disables donation — phase
 spans still overlap at the host level (queue/staleness/backpressure
 all behave), but compute does not. Real overlap needs separate non-CPU
 device groups, where the lock is a no-op and donation is on.
+
+**Deep staleness.** The queue depth worth running is bounded by the
+learner's tolerance for off-policy data, not by the engine: with the
+default clip-only PPO loss, bounds past ~1 visibly bias the surrogate.
+``cfg.ppo.correction = "vtrace"`` (``algos.vtrace``) re-weights the
+advantage targets by clipped importance ratios so bounds >= 4 train
+without that bias — the per-batch mean/max ratios surface on the
+``rlsched_async_importance_ratio_*`` gauges and in ``async_info()`` so
+a drifting ratio is visible before it is a reward regression.
+
+:class:`AsyncPopulationRunner` extends the same engine to the PBT
+population: the vmapped member rollout/learn halves run on the group
+meshes, PBT exploit/explore fires at drained-queue barriers predicted
+from the controller window, and staleness is tracked per member.
 """
 from __future__ import annotations
 
@@ -369,6 +383,11 @@ class AsyncRunner:
         self._staleness_max = 0
         self._staleness_sum = 0
         self._consumed = 0
+        # importance-ratio monitor, fed from the metrics already fetched
+        # at log points (ZERO extra host syncs): 1.0 is the on-policy
+        # neutral value the GAE path reports
+        self._rho_last = 1.0
+        self._rho_max_seen = 1.0
 
     # -- barrier plumbing --------------------------------------------------
 
@@ -552,6 +571,10 @@ class AsyncRunner:
                             self._dispatch_lock:
                         m = {k2: float(v) for k2, v in
                              jax.device_get(metrics)._asdict().items()}
+                    if "rho_mean" in m:
+                        self._rho_last = m["rho_mean"]
+                        self._rho_max_seen = max(self._rho_max_seen,
+                                                 m["rho_max"])
                     history.append({"iteration": b, **m})
                     if logger is not None:
                         logger(b, m)
@@ -561,7 +584,9 @@ class AsyncRunner:
                             staleness=self._staleness_last,
                             actor_idle_s=self._actor_idle_s,
                             learner_idle_s=self._learner_idle_s,
-                            overlap_s=self.overlap.overlap_s)
+                            overlap_s=self.overlap.overlap_s,
+                            importance_ratio_mean=self._rho_last,
+                            importance_ratio_max=self._rho_max_seen)
                 if eval_fn is not None and eval_every and \
                         ((b + 1) % eval_every == 0 or b == iterations - 1):
                     with sections("eval"), tracer.span("eval"), \
@@ -621,7 +646,9 @@ class AsyncRunner:
                                staleness=self._staleness_last,
                                actor_idle_s=self._actor_idle_s,
                                learner_idle_s=self._learner_idle_s,
-                               overlap_s=self.overlap.overlap_s)
+                               overlap_s=self.overlap.overlap_s,
+                               importance_ratio_mean=self._rho_last,
+                               importance_ratio_max=self._rho_max_seen)
             telemetry.run_end(
                 iterations=iterations, wall_s=round(wall, 6),
                 env_steps=total_env_steps,
@@ -647,6 +674,8 @@ class AsyncRunner:
             "staleness_max": self._staleness_max,
             "staleness_mean": (self._staleness_sum / self._consumed
                                if self._consumed else 0.0),
+            "importance_ratio_mean": self._rho_last,
+            "importance_ratio_max": self._rho_max_seen,
         }
 
     def _resample(self) -> None:
@@ -663,3 +692,502 @@ class AsyncRunner:
         exp.carry = jax.tree.map(
             lambda new, old: jax.device_put(new, old.sharding),
             carry, exp.carry)
+
+
+def _make_pop_rollout(apply_fn, env_params, n_steps):
+    """The actor half of the population step: vmap the SAME rollout the
+    fused ``make_population_step`` vmaps — member params/carries mapped,
+    traces broadcast (``in_axes=None``, one shared env-window set for
+    fitness comparability)."""
+    from .algos.rollout import rollout as rollout_fn
+
+    def pop_rollout(params, carries, traces):
+        return jax.vmap(
+            lambda p, c, t: rollout_fn(apply_fn, p, env_params, t, c,
+                                       n_steps),
+            in_axes=(0, 0, None))(params, carries, traces)
+
+    return pop_rollout
+
+
+class AsyncPopulationRunner:
+    """The async engine over a :class:`~.experiment.PopulationExperiment`:
+    the vmapped member ROLLOUT half runs on the actor group, the vmapped
+    member LEARN half (``parallel.population.make_member_learn_step``,
+    traced per-member hyperparameters and all) on the learner group,
+    overlapped through the same bounded queue / staleness gate /
+    barrier machinery as :class:`AsyncRunner`.
+
+    **Why V-trace makes this row legal.** The refusal this class deletes
+    (``MODE_REFUSALS`` ``async x pbt``) existed because PBT's host-side
+    exploit/explore interleaves between steps AND because stale batches
+    bias each member differently, corrupting the fitness comparison the
+    controller ranks on. Both are now handled: exploit rounds fire at
+    drained-queue BARRIERS predicted from the controller window (both
+    loops agree on the schedule up front, so the actor is parked and the
+    weight copy is race-free), and ``correction="vtrace"`` re-weights
+    every member's targets by its own importance ratios so staleness
+    shifts no member's fitness estimate.
+
+    **Placement (v1).** Member stacks are REPLICATED on their group
+    meshes (``actor_replicated`` / ``learner_replicated``); build the
+    population with ``mesh=None`` and let the runner own placement.
+    Sharding the member stack over a ``pop`` axis *within* each async
+    group is an open end (ROADMAP) — it needs per-group meshes with a
+    pop dimension plus a sharded exploit gather, and the bound-0
+    bit-identity contract below is defined against the unsharded sync
+    twin anyway.
+
+    **Bound-0 contract.** ``staleness_bound=0`` reproduces the non-mesh
+    ``PopulationExperiment.run`` loop bit-identically: same key-split
+    program and order, same member program composition (the split
+    rollout/learn halves vmap the same functions the fused
+    ``make_population_step`` vmaps), same exploit schedule (the barrier
+    prediction is exact, and the runner raises if the controller ever
+    fires off-schedule).
+
+    **Per-member staleness.** Batches are stacked, so every member in
+    queue item ``i`` shares the item's version lag; the bookkeeping is
+    still tracked per member because exploit RESETS the exploited
+    members' effective lag (they restart from just-published donor
+    weights). ``async_info()`` reports both the scalar aggregates and
+    the per-member last/max vectors."""
+
+    def __init__(self, pexp, groups: DeviceGroups | None = None,
+                 staleness_bound: int = 1, queue_capacity: int = 2,
+                 stall_timeout_s: float = 300.0):
+        from .parallel.population import make_member_learn_step
+        if staleness_bound < 0:
+            raise ValueError(f"staleness_bound must be >= 0, got "
+                             f"{staleness_bound}")
+        cfg = pexp.cfg
+        if pexp.mesh is not None:
+            raise ValueError(
+                "AsyncPopulationRunner owns device placement (member "
+                "stacks replicated on the actor/learner group meshes); "
+                "build the population with mesh=None. Sharding the pop "
+                "axis within async groups is an open end (ROADMAP)")
+        if groups is None:
+            from .parallel.groups import split_mesh
+            from .parallel.mesh import unified_mesh
+            groups = split_mesh(unified_mesh())
+        # v1 replicates both member stacks on their group meshes, so the
+        # per-phase geometry checks run against a single placement domain
+        validate_rollout_geometry(cfg.ppo.n_steps, cfg.n_envs, 1)
+        validate_update_geometry(cfg.ppo.n_epochs, cfg.ppo.n_minibatches,
+                                 cfg.ppo.minibatch_size,
+                                 n_steps=cfg.ppo.n_steps,
+                                 n_envs=cfg.n_envs, n_devices=1)
+        on_cpu = groups.actor[0].platform == "cpu"
+        self._dispatch_lock: Any = (
+            threading.Lock() if on_cpu else contextlib.nullcontext())
+        self.pexp = pexp
+        self.groups = groups
+        self.staleness_bound = staleness_bound
+        self.queue_capacity = queue_capacity
+        self._stall_timeout_s = stall_timeout_s
+        self._clock = time.monotonic
+
+        # adopt the population onto the group meshes (explicit placements;
+        # the experiment object stays the canonical holder so
+        # save/restore_checkpoint and member_eval_view work unchanged)
+        self._arep = groups.actor_replicated()
+        self._lrep = groups.learner_replicated()
+        pexp.traces = put_global(pexp.traces, self._arep)
+        pexp.carries = put_global(pexp.carries, self._arep)
+        pexp.states = put_global(pexp.states, self._lrep)
+        pexp.keys = jax.device_put(pexp.keys, self._lrep)
+        pexp.hparams = put_global(pexp.hparams, self._lrep)
+
+        apply_fn = pexp.apply_fn
+        pop_learn = jax.vmap(make_member_learn_step(apply_fn, cfg.ppo),
+                             in_axes=(0, 0, 0, 0, 0))
+
+        # same AOT-compile + CPU donation-off reasoning as AsyncRunner
+        rollout_donate = () if on_cpu else (1,)   # the carry stack
+        learn_donate = () if on_cpu else (0,)     # the member-state stack
+        params_a = jax.device_put(pexp.states.params, self._arep)
+        rollout_jit = jax.jit(
+            _make_pop_rollout(apply_fn, pexp.env_params, cfg.ppo.n_steps),
+            donate_argnums=rollout_donate)
+        self._rollout = rollout_jit.lower(
+            params_a, pexp.carries, pexp.traces).compile()
+        _, tr_s, lv_s = jax.eval_shape(rollout_jit, params_a, pexp.carries,
+                                       pexp.traces)
+        tr0 = jax.device_put(jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), tr_s), self._lrep)
+        lv0 = jax.device_put(jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), lv_s), self._lrep)
+        subs0 = jax.device_put(
+            jnp.zeros(pexp.keys.shape, pexp.keys.dtype), self._lrep)
+        self._learn = jax.jit(
+            pop_learn, donate_argnums=learn_donate).lower(
+                pexp.states, tr0, lv0, subs0, pexp.hparams).compile()
+        del tr0, lv0, subs0
+        # the sync population loop's per-iteration key split — the SAME
+        # jit(vmap(split)) program in the same order, for bound-0 parity
+        self._split_all = jax.jit(jax.vmap(lambda k: jax.random.split(k)))
+
+        # loop state shared across run() calls
+        self._iterations_done = 0
+        self._slot = _ParamSlot(
+            params_a, version=0,
+            clock=self._clock, stall_timeout_s=stall_timeout_s)
+        self.queue = TrajectoryQueue(queue_capacity, clock=self._clock,
+                                     stall_timeout_s=stall_timeout_s)
+        self.overlap = OverlapMeter(clock=self._clock)
+        self._bar_cv = threading.Condition()
+        self._barriers: list[int] = []
+        self._barriers_done = 0
+        self._failure: BaseException | None = None
+        self._actor_idle_s = 0.0
+        self._learner_idle_s = 0.0
+        self._staleness_last = 0
+        self._staleness_max = 0
+        self._staleness_sum = 0
+        self._consumed = 0
+        # per-member lag vectors: uniform per stacked item, but exploit
+        # resets the exploited members' LAST lag (fresh donor weights)
+        self._stale_last_pm = [0] * pexp.n_pop
+        self._stale_max_pm = [0] * pexp.n_pop
+        self._rho_last = 1.0
+        self._rho_max_seen = 1.0
+
+    # -- barrier plumbing (same protocol as AsyncRunner) --------------------
+
+    def _wait_barriers_before(self, i: int) -> float:
+        t0 = self._clock()
+        with self._bar_cv:
+            need = bisect.bisect_left(self._barriers, i)
+            while self._barriers_done < need:
+                if self._failure is not None:
+                    raise _Aborted()
+                if self._clock() - t0 > self._stall_timeout_s:
+                    raise RuntimeError(
+                        f"actor stalled at barrier before iteration {i}")
+                self._bar_cv.wait(_WAIT_TICK_S)
+        return self._clock() - t0
+
+    def _complete_barrier(self) -> None:
+        with self._bar_cv:
+            self._barriers_done += 1
+            self._bar_cv.notify_all()
+
+    def _abort(self, exc: BaseException) -> None:
+        self._failure = exc
+        self.queue.abort(exc)
+        self._slot.abort()
+        with self._bar_cv:
+            self._bar_cv.notify_all()
+
+    # -- the actor loop (background thread) ---------------------------------
+
+    def _actor_loop(self, base: int, iterations: int,
+                    sections: SectionTimer, tracer) -> None:
+        pexp = self.pexp
+        carries = pexp.carries
+        try:
+            for k in range(iterations):
+                i = base + k
+                with tracer.span("actor_barrier_wait"):
+                    self._actor_idle_s += self._wait_barriers_before(i)
+                with tracer.span("actor_gate_wait"):
+                    params, version, gated = self._slot.wait_for(
+                        i - self.staleness_bound)
+                self._actor_idle_s += gated
+                carries = pexp.carries
+                with tracer.span("actor", iteration=i), \
+                        self.overlap.span("actor"), sections("actor"), \
+                        no_implicit_transfers(), self._dispatch_lock:
+                    carries, tr, last_value = self._rollout(
+                        params, carries, pexp.traces)
+                    batch = (jax.device_put(tr, self._lrep),
+                             jax.device_put(last_value, self._lrep))
+                    jax.block_until_ready(batch)
+                pexp.carries = carries
+                with tracer.span("queue_push_wait"):
+                    self._actor_idle_s += self.queue.put(
+                        _QueueItem(index=i, version=version, batch=batch))
+        except _Aborted:
+            pass
+        except BaseException as e:
+            self._abort(e)
+
+    # -- the learner loop (caller thread) -----------------------------------
+
+    def run(self, iterations: int | None = None, log_every: int = 0,
+            logger: Callable[[int, dict], None] | None = None,
+            ckpt=None, ckpt_every: int = 0,
+            eval_every: int = 0,
+            eval_fn: "Callable[[int], dict] | None" = None,
+            eval_logger: Callable[[int, dict], None] | None = None,
+            telemetry=None) -> dict:
+        """Run ``iterations`` overlapped population iterations; the hook
+        surface mirrors :meth:`PopulationExperiment.run` minus
+        watchdog/injector (chaos drills stay on the sync loop). PBT
+        exploit/explore and checkpoints run at drained-queue barriers."""
+        pexp = self.pexp
+        cfg = pexp.cfg
+        ctrl = pexp.controller
+        iterations = iterations or cfg.iterations
+        base = self._iterations_done
+        history: list[dict] = []
+        eval_history: list[dict] = []
+        sections = (telemetry.sections if telemetry is not None
+                    else SectionTimer())
+        gauges = (AsyncGauges(telemetry.registry)
+                  if telemetry is not None else None)
+        tracer = tracer_of(telemetry)
+
+        def is_ckpt(b: int) -> bool:
+            return bool(ckpt is not None and ckpt_every
+                        and ((b + 1) % ckpt_every == 0
+                             or b == iterations - 1))
+
+        # predict the controller's exploit iterations so both loops agree
+        # on the barrier set up front: maybe_update consults ONLY its
+        # recorded-window count (never the iteration number) and resets
+        # the window on fire, so with `window` records carried in from
+        # earlier run() calls, local iteration b fires exactly when
+        # (window + b + 1) % ready_iters == 0
+        window = ctrl._fitness_n + len(ctrl._pending)
+        ready = ctrl.cfg.ready_iters
+
+        def is_exploit(b: int) -> bool:
+            return (window + b + 1) % ready == 0
+
+        local_barriers = sorted(b for b in range(iterations)
+                                if is_ckpt(b) or is_exploit(b))
+        with self._bar_cv:
+            self._barriers = [base + b for b in local_barriers]
+            self._barriers_done = 0
+        self._failure = None
+
+        if telemetry is not None:
+            telemetry.run_start(
+                loop="async-population", config=cfg.name,
+                n_pop=pexp.n_pop, iterations=iterations,
+                n_envs=cfg.n_envs,
+                steps_per_iteration=pexp.steps_per_iteration,
+                staleness_bound=self.staleness_bound,
+                queue_capacity=self.queue_capacity,
+                actor_devices=[d.id for d in self.groups.actor],
+                learner_devices=[d.id for d in self.groups.learner],
+                shared_group=self.groups.shared)
+
+        t0 = time.monotonic()
+        actor = threading.Thread(
+            target=self._actor_loop,
+            args=(base, iterations, sections, tracer),
+            name="async-pop-actor", daemon=True)
+        actor.start()
+        try:
+            for k in range(iterations):
+                b = k
+                i = base + k
+                if telemetry is not None:
+                    telemetry.begin_iteration(b)
+                with sections("queue_wait"), \
+                        tracer.span("queue_pop_wait"):
+                    item, waited = self.queue.get()
+                self._learner_idle_s += waited
+                if item.index != i:
+                    raise RuntimeError(
+                        f"queue order violation: expected batch {i}, "
+                        f"got {item.index}")
+                staleness = item.index - item.version
+                if staleness > self.staleness_bound:
+                    raise StalenessError(
+                        f"batch {item.index} was collected at policy "
+                        f"version {item.version} — {staleness} versions "
+                        f"behind, bound is {self.staleness_bound}")
+                self._staleness_last = staleness
+                self._staleness_max = max(self._staleness_max, staleness)
+                self._staleness_sum += staleness
+                self._consumed += 1
+                for p in range(pexp.n_pop):
+                    self._stale_last_pm[p] = staleness
+                    self._stale_max_pm[p] = max(self._stale_max_pm[p],
+                                                staleness)
+                guard = (telemetry.dispatch(b) if telemetry is not None
+                         else contextlib.nullcontext())
+                tr, last_value = item.batch
+                with tracer.span("learner", iteration=b), \
+                        self.overlap.span("learner"), \
+                        sections("learner"), guard, self._dispatch_lock:
+                    # the sync population loop's per-iteration split,
+                    # same program and order
+                    both = self._split_all(pexp.keys)
+                    keys2, subs = both[:, 0], both[:, 1]
+                    states, metrics = self._learn(
+                        pexp.states, tr, last_value, subs, pexp.hparams)
+                    params_a = jax.device_put(states.params, self._arep)
+                    jax.block_until_ready(params_a)
+                pexp.keys = keys2
+                pexp.states = states
+                self._slot.publish(params_a, i + 1)
+
+                # PBT bookkeeping every iteration, as in the sync loop:
+                # record is a device-array append (no sync), maybe_update
+                # fires only at the barrier-predicted iterations — if it
+                # ever fires off-schedule the actor is NOT parked, so
+                # fail loudly rather than race the weight copy
+                ctrl.record(metrics.mean_reward)
+                out = ctrl.maybe_update(i, pexp.states, pexp.hparams)
+                if (out is not None) != is_exploit(b):
+                    raise RuntimeError(
+                        f"PBT exploit fired off the predicted barrier "
+                        f"schedule at iteration {b} (window={window}, "
+                        f"ready_iters={ready}) — controller state was "
+                        f"mutated outside the runner")
+                if out is not None:
+                    states2, hparams2, decision = out
+                    with sections("pbt"), tracer.span("pbt_exploit"), \
+                            self._dispatch_lock:
+                        # the exploit gather pins its outputs to the
+                        # input (learner) shardings; the host-side
+                        # explore hands back fresh uncommitted arrays
+                        pexp.states = states2
+                        pexp.hparams = put_global(hparams2, self._lrep)
+                        params_a = jax.device_put(pexp.states.params,
+                                                  self._arep)
+                        jax.block_until_ready(params_a)
+                    # re-publish the exploited weights under the SAME
+                    # version: the parked actor then collects batch i+1
+                    # with post-exploit params, exactly like the sync loop
+                    self._slot.publish(params_a, i + 1)
+                    exploited = [bool(x) for x in decision.exploited]
+                    for p, ex in enumerate(exploited):
+                        if ex:
+                            self._stale_last_pm[p] = 0
+                    if telemetry is not None:
+                        telemetry.emit(
+                            "pbt_exploit", iteration=b,
+                            exploited=int(sum(exploited)),
+                            src=[int(s) for s in decision.src])
+
+                want_log = bool(log_every) and (b % log_every == 0
+                                                or b == iterations - 1)
+                m = None
+                if want_log:
+                    # ONE batched device_get for the whole [P]-metrics
+                    # tuple, flattened to suffixed scalar columns + _mean
+                    # (same CSV schema as the sync population loop)
+                    m = {}
+                    with sections("sync"), tracer.span("sync"), \
+                            self._dispatch_lock:
+                        got = jax.device_get(metrics)._asdict()
+                    for k2, v in got.items():
+                        vals = [float(x) for x in v]
+                        m.update({f"{k2}_{p}": x
+                                  for p, x in enumerate(vals)})
+                        m[f"{k2}_mean"] = sum(vals) / len(vals)
+                    if "rho_mean_mean" in m:
+                        self._rho_last = m["rho_mean_mean"]
+                        self._rho_max_seen = max(
+                            self._rho_max_seen,
+                            max(float(x) for x in got["rho_max"]))
+                    history.append({"iteration": b, **m})
+                    if logger is not None:
+                        logger(b, m)
+                    if gauges is not None:
+                        gauges.publish(
+                            queue_depth=len(self.queue),
+                            staleness=self._staleness_last,
+                            actor_idle_s=self._actor_idle_s,
+                            learner_idle_s=self._learner_idle_s,
+                            overlap_s=self.overlap.overlap_s,
+                            importance_ratio_mean=self._rho_last,
+                            importance_ratio_max=self._rho_max_seen)
+                if eval_fn is not None and eval_every and \
+                        ((b + 1) % eval_every == 0 or b == iterations - 1):
+                    with sections("eval"), tracer.span("eval"), \
+                            self._dispatch_lock:
+                        em = dict(eval_fn(b))
+                    eval_history.append({"iteration": b, **em})
+                    if eval_logger is not None:
+                        eval_logger(b, em)
+                if is_ckpt(b):
+                    with sections("ckpt"), tracer.span("ckpt"):
+                        pexp.save_checkpoint(
+                            ckpt, meta={"iteration": b,
+                                        "async_iteration": i,
+                                        "staleness_bound":
+                                            self.staleness_bound})
+                if is_ckpt(b) or is_exploit(b):
+                    self._complete_barrier()
+                if telemetry is not None:
+                    telemetry.end_iteration(
+                        b, m if want_log else None,
+                        pexp.steps_per_iteration)
+                if self._failure is not None:
+                    raise self._failure
+        except BaseException as e:
+            self._abort(e)
+            actor.join(timeout=30)
+            raise
+        actor.join(timeout=self._stall_timeout_s)
+        if actor.is_alive():
+            exc = RuntimeError("actor thread failed to drain")
+            self._abort(exc)
+            raise exc
+        if self._failure is not None:
+            raise self._failure
+        jax.block_until_ready(pexp.states.params)
+        self._iterations_done = base + iterations
+        wall = time.monotonic() - t0
+        total_env_steps = iterations * pexp.steps_per_iteration
+        async_info = self.async_info()
+        out = {"wall_s": wall, "iterations": iterations,
+               "env_steps": total_env_steps,
+               "env_steps_per_sec": total_env_steps / wall,
+               "final_fitness": [float(f) for f in ctrl.mean_fitness],
+               "pbt_events": len(ctrl.history),
+               "history": history,
+               "phase_seconds": {k2: round(v, 6)
+                                 for k2, v in sections.report().items()},
+               "async": async_info}
+        if eval_history:
+            out["eval_history"] = eval_history
+        if telemetry is not None:
+            if gauges is not None:
+                gauges.publish(queue_depth=len(self.queue),
+                               staleness=self._staleness_last,
+                               actor_idle_s=self._actor_idle_s,
+                               learner_idle_s=self._learner_idle_s,
+                               overlap_s=self.overlap.overlap_s,
+                               importance_ratio_mean=self._rho_last,
+                               importance_ratio_max=self._rho_max_seen)
+            telemetry.run_end(
+                iterations=iterations, wall_s=round(wall, 6),
+                env_steps=total_env_steps,
+                env_steps_per_sec=round(out["env_steps_per_sec"], 3),
+                pbt_events=len(ctrl.history),
+                **{f"async_{k2}": v for k2, v in async_info.items()
+                   if not isinstance(v, (list, dict))})
+        return out
+
+    def async_info(self) -> dict:
+        """Overlap/staleness accounting, including the per-member lag
+        vectors (uniform per stacked batch; exploit resets the exploited
+        members' LAST lag)."""
+        snap = self.overlap.snapshot()
+        return {
+            "staleness_bound": self.staleness_bound,
+            "queue_capacity": self.queue_capacity,
+            "actor_devices": [d.id for d in self.groups.actor],
+            "learner_devices": [d.id for d in self.groups.learner],
+            "shared_group": self.groups.shared,
+            "overlap_s": snap["overlap_s"],
+            "actor_busy_s": snap.get("busy_actor_s", 0.0),
+            "learner_busy_s": snap.get("busy_learner_s", 0.0),
+            "actor_idle_s": round(self._actor_idle_s, 6),
+            "learner_idle_s": round(self._learner_idle_s, 6),
+            "staleness_max": self._staleness_max,
+            "staleness_mean": (self._staleness_sum / self._consumed
+                               if self._consumed else 0.0),
+            "staleness_last_per_member": list(self._stale_last_pm),
+            "staleness_max_per_member": list(self._stale_max_pm),
+            "importance_ratio_mean": self._rho_last,
+            "importance_ratio_max": self._rho_max_seen,
+        }

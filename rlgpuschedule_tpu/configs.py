@@ -162,6 +162,8 @@ MODE_FLAGS: dict[str, str] = {
     "hier": "hierarchical config (n_pods > 1)",
     "shard_map": "shard_map/axis_name build",
     "mesh": "--mesh",
+    "vtrace": "--correction vtrace",
+    "sync": "the synchronous loop (no --async)",
 }
 
 # THE mode-combination refusal matrix — every pairwise refusal `train`
@@ -170,8 +172,19 @@ MODE_FLAGS: dict[str, str] = {
 # scattered through train.main. Order within a pair is cosmetic; the
 # check is symmetric. Each entry: (mode_a, mode_b, why-it-refuses).
 MODE_REFUSALS: tuple[tuple[str, str, str], ...] = (
-    ("async", "pbt",
-     "the PBT loop interleaves host-side exploit/explore between steps"),
+    # async x pbt was refused here until ISSUE 12: AsyncPopulationRunner
+    # now runs PBT exploit/explore at drained-queue barriers, with
+    # V-trace keeping stale batches from skewing the fitness ranking
+    ("vtrace", "sync",
+     "importance correction divides the target policy by the behavior "
+     "policy; the sync loop collects every batch on-policy (ratios are "
+     "identically 1), so --correction vtrace without --async would only "
+     "buy the extra forward pass — the bit-identity contract makes this "
+     "a no-op, refuse it loudly instead"),
+    ("vtrace", "hier",
+     "the hierarchical joint log-prob sums router+placer heads; the "
+     "V-trace ratio recompute has not been validated against the "
+     "multi-head action distribution yet"),
     ("async", "fused_chunk",
      "the async engine already overlaps phases — pick one"),
     ("async", "rollbacks",

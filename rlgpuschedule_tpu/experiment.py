@@ -248,7 +248,8 @@ class Experiment:
         ex_obs, ex_mask = jax.tree.map(lambda x: x[:1],
                                        (carry.obs, carry.mask))
         train_state = make_train_state(net, init_key, ex_obs, ex_mask, tx,
-                                       extra)
+                                       extra,
+                                       reward_norm=algo_cfg.reward_norm)
         if jit:
             if axis_name is not None:
                 # pmean(axis_name) is unbound under plain jit — the
@@ -855,6 +856,33 @@ class PopulationExperiment:
         in the traced :class:`~parallel.population.HParams` (not the
         optimizer), so the decay is one array multiply — no re-trace."""
         self.hparams = self.hparams._replace(lr=self.hparams.lr * scale)
+
+    def run_async(self, iterations: int | None = None, *,
+                  groups=None, staleness_bound: int = 1,
+                  queue_capacity: int = 2, log_every: int = 0,
+                  logger: Callable[[int, dict], None] | None = None,
+                  ckpt=None, ckpt_every: int = 0, eval_every: int = 0,
+                  eval_fn: "Callable[[int], dict] | None" = None,
+                  eval_logger: Callable[[int, dict], None] | None = None,
+                  telemetry=None) -> dict:
+        """Opt-in async actor–learner loop over the whole population
+        (:class:`~.async_engine.AsyncPopulationRunner`): the vmapped
+        member rollout overlaps the vmapped member update, PBT
+        exploit/explore fires at drained-queue barriers, and
+        ``staleness_bound=0`` reproduces :meth:`run` bit-identically
+        (non-mesh build — construction requires ``mesh=None`` and places
+        member stacks on the group meshes itself). Deep bounds want
+        ``cfg.ppo.correction="vtrace"`` so stale batches do not skew the
+        cross-member fitness ranking. Watchdog/injector chaos drills are
+        sync-path-only."""
+        from .async_engine import AsyncPopulationRunner
+        runner = AsyncPopulationRunner(self, groups=groups,
+                                       staleness_bound=staleness_bound,
+                                       queue_capacity=queue_capacity)
+        return runner.run(iterations, log_every=log_every, logger=logger,
+                          ckpt=ckpt, ckpt_every=ckpt_every,
+                          eval_every=eval_every, eval_fn=eval_fn,
+                          eval_logger=eval_logger, telemetry=telemetry)
 
     def fold_key(self, n: int) -> None:
         """Deterministically diverge every member's rollout RNG stream

@@ -417,12 +417,23 @@ class AsyncGauges:
             "rlsched_async_overlap_s",
             "cumulative wall seconds actor and learner were busy "
             "simultaneously")
+        self.rho_mean = registry.gauge(
+            "rlsched_async_importance_ratio_mean",
+            "mean unclipped importance ratio of the last logged update "
+            "(1.0 = on-policy; the V-trace off-policyness monitor)")
+        self.rho_max = registry.gauge(
+            "rlsched_async_importance_ratio_max",
+            "max unclipped importance ratio seen at any logged update "
+            "this run")
 
     def publish(self, *, queue_depth: int, staleness: int,
                 actor_idle_s: float, learner_idle_s: float,
-                overlap_s: float) -> None:
+                overlap_s: float, importance_ratio_mean: float = 1.0,
+                importance_ratio_max: float = 1.0) -> None:
         self.queue_depth.set(queue_depth)
         self.param_staleness.set(staleness)
         self.actor_idle.set(round(actor_idle_s, 6))
         self.learner_idle.set(round(learner_idle_s, 6))
         self.overlap.set(round(overlap_s, 6))
+        self.rho_mean.set(round(importance_ratio_mean, 6))
+        self.rho_max.set(round(importance_ratio_max, 6))

@@ -26,11 +26,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ..algos.ppo import (PPOConfig, PPOMetrics, normalize_advantages,
+from ..algos.ppo import (PPOConfig, PPOMetrics, compute_advantages,
                          run_ppo_epochs)
 from ..algos.rollout import PolicyApply, RolloutCarry, rollout
 from ..env.env import EnvParams
-from ..ops.gae import compute_gae
 from .mesh import Mesh, env_sharded, pop_env_sharded, pop_sharded
 
 
@@ -75,24 +74,33 @@ def init_member(net, key: jax.Array, example_obs, example_mask,
                        step=jnp.int32(0))
 
 
-def make_member_step(apply_fn: PolicyApply, env_params: EnvParams,
-                     config: PPOConfig) -> Callable:
-    """One member's PPO iteration with traced hyperparameters:
-    (member_state, carry, traces, key, hp) -> (member_state', carry',
-    metrics). The update core is ``algos.ppo.run_ppo_epochs`` with
-    hp.{clip_eps, ent_coef} fed into the loss and hp.lr applied to the
-    adam-preconditioned updates (so optax.adam == scale_by_adam + our
-    scale is preserved exactly when hp matches the config)."""
+def make_member_learn_step(apply_fn: PolicyApply,
+                           config: PPOConfig) -> Callable:
+    """The learn half of one member's PPO iteration with traced
+    hyperparameters: (member_state, tr, last_value, key, hp) ->
+    (member_state', metrics). Advantage targets come from the shared
+    fused pipeline (``algos.ppo.compute_advantages``) — so a population
+    config with ``correction="vtrace"`` gets per-member importance
+    correction, which is what makes the async PBT engine's deep
+    staleness bounds safe. The update core is
+    ``algos.ppo.run_ppo_epochs`` with hp.{clip_eps, ent_coef} fed into
+    the loss and hp.lr applied to the adam-preconditioned updates (so
+    optax.adam == scale_by_adam + our scale is preserved exactly when hp
+    matches the config). Split out of :func:`make_member_step` so the
+    async engine can vmap/compile it alone on the learner group —
+    identical code on both paths, same factoring contract as
+    ``algos.ppo.make_learn_step``."""
     tx = make_member_tx(config)
+    if config.reward_norm:
+        raise ValueError(
+            "reward_norm is not supported in the PBT population: "
+            "MemberState carries no reward_stats (per-member streaming "
+            "moments would make fitness incomparable across members)")
 
-    def member_step(state: MemberState, carry: RolloutCarry, traces,
-                    key: jax.Array, hp: HParams):
-        carry, tr, last_value = rollout(apply_fn, state.params, env_params,
-                                        traces, carry, config.n_steps)
-        advantages, returns = compute_gae(tr.reward, tr.value, tr.done,
-                                          last_value, config.gamma,
-                                          config.gae_lambda)
-        advantages = normalize_advantages(advantages)
+    def member_learn_step(state: MemberState, tr, last_value: jax.Array,
+                          key: jax.Array, hp: HParams):
+        state, advantages, returns, rho_stats = compute_advantages(
+            apply_fn, config, state, tr, last_value)
 
         def apply_grads(state: MemberState, grads) -> MemberState:
             updates, opt_state = tx.update(grads, state.opt_state,
@@ -104,7 +112,25 @@ def make_member_step(apply_fn: PolicyApply, env_params: EnvParams,
 
         state, metrics = run_ppo_epochs(
             apply_fn, config, state, tr, advantages, returns, key,
-            apply_grads, clip_eps=hp.clip_eps, ent_coef=hp.ent_coef)
+            apply_grads, clip_eps=hp.clip_eps, ent_coef=hp.ent_coef,
+            rho_stats=rho_stats)
+        return state, metrics
+
+    return member_learn_step
+
+
+def make_member_step(apply_fn: PolicyApply, env_params: EnvParams,
+                     config: PPOConfig) -> Callable:
+    """One member's full PPO iteration:
+    (member_state, carry, traces, key, hp) -> (member_state', carry',
+    metrics) — the rollout composed with :func:`make_member_learn_step`."""
+    learn = make_member_learn_step(apply_fn, config)
+
+    def member_step(state: MemberState, carry: RolloutCarry, traces,
+                    key: jax.Array, hp: HParams):
+        carry, tr, last_value = rollout(apply_fn, state.params, env_params,
+                                        traces, carry, config.n_steps)
+        state, metrics = learn(state, tr, last_value, key, hp)
         return state, carry, metrics
 
     return member_step

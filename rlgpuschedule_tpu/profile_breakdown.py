@@ -7,7 +7,13 @@ so this CLI decomposes the fused PPO train step into its three stages and
 measures the host gap:
 
 - **rollout**: the fused policy+env ``lax.scan`` (HOT LOOP #1),
-- **gae**: the reverse-scan advantage computation,
+- **gae**: the bare reverse-scan advantage computation (reference row),
+- **advantage**: the production fused advantage pipeline
+  (``algos.ppo.compute_advantages``: optional streaming reward
+  standardization → GAE or V-trace → global normalization → optional
+  bf16 storage). With default flags this is the gae row plus
+  normalization; ``--correction vtrace`` prices the batched
+  target-policy recompute the off-policy path adds on top,
 - **update**: epoch × minibatch clipped-surrogate updates (HOT LOOP #2),
 - **fused_loop**: the production one-jit step (rollout+gae+update
   together — XLA may fuse across stages, so fused ≤ sum(parts) is
@@ -28,7 +34,8 @@ Usage::
     python -m rlgpuschedule_tpu.profile_breakdown [--cpu] [--repeats 5]
         [--trace-dir /tmp/jax-trace] [--n-envs 512] [--n-steps 128]
         [--n-epochs 2] [--n-minibatches 8 | --minibatch-size N]
-        [--bf16-update]
+        [--bf16-update] [--correction vtrace] [--reward-norm]
+        [--bf16-advantages]
     python -m rlgpuschedule_tpu.profile_breakdown [--cpu] \
         --sweep-minibatch [--sweep-out sweep.json]
     python -m rlgpuschedule_tpu.profile_breakdown [--cpu] \
@@ -94,7 +101,8 @@ BF16_PEAK = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
 
 
 def _sweep_minibatch(args, ppo, platform, kind, peak, B, n_params,
-                     timed_update, state, tr, adv, ret, key, n) -> dict:
+                     timed_update, state, tr, adv, ret, key, n,
+                     t_adv) -> dict:
     """Time the update stage over the geometry grid — epochs in
     ``{1, configured}`` × every power-of-two minibatch count that tiles
     the batch (plus the configured default) — and rank the geometries
@@ -145,6 +153,13 @@ def _sweep_minibatch(args, ppo, platform, kind, peak, B, n_params,
         "n_envs": tr.reward.shape[1], "n_steps": ppo.n_steps,
         "batch_per_iteration": B,
         "bf16_update": ppo.bf16_update,
+        "advantage_pipeline": {"correction": ppo.correction,
+                               "reward_norm": ppo.reward_norm,
+                               "bf16_advantages": ppo.bf16_advantages},
+        # the advantage phase is geometry-invariant (it runs once per
+        # iteration, before the epoch×minibatch grid) — one row
+        # contextualizes every geometry's update time against it
+        "advantage_s_per_iteration": round(t_adv, 5),
         "policy_params": int(n_params),
         "assumed_bf16_peak_flops": peak,
         "default_geometry": {"n_epochs": ppo.n_epochs,
@@ -246,6 +261,19 @@ def main(argv: list[str] | None = None) -> dict:
     ap.add_argument("--bf16-update", action="store_true",
                     help="profile the bf16-compute / fp32-optimizer "
                          "update path")
+    ap.add_argument("--correction", choices=["none", "vtrace"],
+                    default="none",
+                    help="advantage pipeline: V-trace importance-corrected "
+                         "targets instead of plain GAE — the advantage row "
+                         "then prices the batched target-policy recompute "
+                         "the off-policy path adds")
+    ap.add_argument("--reward-norm", action="store_true",
+                    help="advantage pipeline: streaming Welford reward "
+                         "standardization before the target scan")
+    ap.add_argument("--bf16-advantages", action="store_true",
+                    help="advantage pipeline: store advantages/returns in "
+                         "bf16 (halves the tensors' HBM traffic; the "
+                         "update still computes fp32)")
     ap.add_argument("--sweep-minibatch", action="store_true",
                     help="time the update stage over a grid of minibatch "
                          "geometries and emit a ranked JSON artifact "
@@ -287,7 +315,8 @@ def main(argv: list[str] | None = None) -> dict:
     import jax.numpy as jnp
 
     from rlgpuschedule_tpu.algos import PPOConfig, resolve_geometry
-    from rlgpuschedule_tpu.algos.ppo import (normalize_advantages,
+    from rlgpuschedule_tpu.algos.ppo import (compute_advantages,
+                                             normalize_advantages,
                                              run_ppo_epochs)
     from rlgpuschedule_tpu.algos.rollout import rollout
     from rlgpuschedule_tpu.algos.update import make_update_step
@@ -303,7 +332,10 @@ def main(argv: list[str] | None = None) -> dict:
     ppo = PPOConfig(n_steps=n_steps, n_epochs=args.n_epochs,
                     n_minibatches=args.n_minibatches,
                     minibatch_size=args.minibatch_size,
-                    bf16_update=args.bf16_update)
+                    bf16_update=args.bf16_update,
+                    correction=args.correction,
+                    reward_norm=args.reward_norm,
+                    bf16_advantages=args.bf16_advantages)
     cfg = dataclasses.replace(PPO_MLP_SYNTH64, n_envs=n_envs, ppo=ppo)
     if args.async_run:
         out = _profile_async(args, cfg, platform)
@@ -341,6 +373,14 @@ def main(argv: list[str] | None = None) -> dict:
                                ppo.gamma, ppo.gae_lambda)
         return normalize_advantages(adv), ret
 
+    @jax.jit
+    def advantage_only(state, tr, last_value):
+        # the production pipeline (reward-norm → GAE/V-trace → normalize
+        # → bf16 store); with default flags it lowers to gae_only's ops
+        _st, a, r, _rho = compute_advantages(apply_fn, ppo, state, tr,
+                                             last_value)
+        return a, r
+
     # ONE jitted copy program shared by every _timed_update call: the
     # sweep times a dozen geometries, and a fresh jax.jit(lambda) per
     # call would recompile the copy once per geometry (jsan
@@ -369,13 +409,20 @@ def main(argv: list[str] | None = None) -> dict:
 
     _, tr, last_value = jax.block_until_ready(
         rollout_only(state.params, carry))
-    adv, ret = jax.block_until_ready(gae_only(tr, last_value))
+    jax.block_until_ready(gae_only(tr, last_value))        # compile + warm
+    # the update/sweep timings consume the PRODUCTION pipeline's outputs
+    # (bf16 storage changes the tensors the update reads)
+    adv, ret = jax.block_until_ready(advantage_only(state, tr, last_value))
 
     n = args.iters_per_repeat
+    t_adv = _median_time(
+        lambda: jax.block_until_ready(
+            [advantage_only(state, tr, last_value) for _ in range(n)]),
+        args.repeats) / n
     if args.sweep_minibatch:
         out = _sweep_minibatch(args, ppo, platform, kind, peak, B, n_params,
                                _timed_update, state, tr, adv, ret, k_sweep,
-                               n)
+                               n, t_adv)
         print(json.dumps(out))
         if args.sweep_out:
             with open(args.sweep_out, "w") as f:
@@ -414,7 +461,9 @@ def main(argv: list[str] | None = None) -> dict:
         with profiling.trace(args.trace_dir):
             fused_loop()
 
-    t_parts = t_roll + t_gae + t_upd
+    # parts = the production decomposition (rollout → advantage pipeline
+    # → update); the bare gae row stays as the pre-fusion reference
+    t_parts = t_roll + t_adv + t_upd
     pipeline_overlap = max(t_blocked - t_loop, 0.0)
 
     # model-FLOPs proxy: 2*params per fwd MAC, 3x for fwd+bwd, over every
@@ -431,14 +480,18 @@ def main(argv: list[str] | None = None) -> dict:
         "geometry": {"n_epochs": ppo.n_epochs, "n_minibatches": n_mb,
                      "minibatch_size": mb,
                      "bf16_update": ppo.bf16_update},
+        "advantage_pipeline": {"correction": ppo.correction,
+                               "reward_norm": ppo.reward_norm,
+                               "bf16_advantages": ppo.bf16_advantages},
         "seconds_per_iteration": {
             "rollout": round(t_roll, 5), "gae": round(t_gae, 5),
+            "advantage": round(t_adv, 5),
             "update": round(t_upd, 5), "fused_loop": round(t_loop, 5),
             "fused_step_blocked": round(t_blocked, 5),
             "pipeline_overlap": round(pipeline_overlap, 5)},
         "stage_share_of_parts": {
             "rollout": round(t_roll / t_parts, 3),
-            "gae": round(t_gae / t_parts, 3),
+            "advantage": round(t_adv / t_parts, 3),
             "update": round(t_upd / t_parts, 3)},
         "env_steps_per_sec": round(B / t_loop, 1),
         "policy_params": int(n_params),

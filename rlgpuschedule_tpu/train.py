@@ -104,6 +104,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--bf16-update", action="store_true", default=None,
                    help="bf16-compute / fp32-optimizer-state update path "
                         "(NOT bit-identical to the fp32 default)")
+    # fused advantage pipeline (ISSUE 12): off-policy correction +
+    # streaming reward normalization + compact advantage storage
+    p.add_argument("--correction", default=None,
+                   choices=["none", "vtrace"],
+                   help="off-policy advantage correction (PPO only). "
+                        "'vtrace' re-weights the advantage scan by "
+                        "rho/c-clipped importance ratios (algos.vtrace) "
+                        "so deep --staleness-bound queues train without "
+                        "bias; requires --async (on-policy ratios are "
+                        "identically 1 and the correction reduces "
+                        "bit-identically to the GAE path, so the sync "
+                        "combination is refused as a silent no-op)")
+    p.add_argument("--reward-norm", action="store_true", default=None,
+                   help="streaming reward standardization: scale rewards "
+                        "by a running inverse-std (Welford moments "
+                        "carried in the train state, scale-only — no "
+                        "centering, so sparse-reward signs survive) "
+                        "before the advantage scan")
+    p.add_argument("--bf16-advantages", action="store_true", default=None,
+                   help="store advantage/return targets in bfloat16 "
+                        "between the advantage scan and the minibatch "
+                        "epochs (halves the target buffer; NOT "
+                        "bit-identical — loss math upcasts to fp32)")
     # async actor-learner split (async_engine; opt-in)
     p.add_argument("--async", dest="async_run", action="store_true",
                    help="overlapped actor-learner engine: rollout "
@@ -126,7 +149,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --async: max update-steps the policy that "
                         "collected a batch may lag the learner at "
                         "consume time (0 = lock-step, bit-identical to "
-                        "the sync path; default 1)")
+                        "the sync path; default 1). Bounds >= 4 run the "
+                        "queue deep enough to hide slow actors but bias "
+                        "the clip-only surrogate — pair them with "
+                        "--correction vtrace")
     p.add_argument("--queue-capacity", type=int, default=2,
                    help="with --async: trajectory-queue slots; a full "
                         "queue blocks the actor (backpressure, no drops)")
@@ -279,8 +305,19 @@ def apply_overrides(cfg: ExperimentConfig,
                    "n_epochs": args.n_epochs,
                    "n_minibatches": args.n_minibatches,
                    "minibatch_size": args.minibatch_size,
-                   "bf16_update": args.bf16_update}
+                   "bf16_update": args.bf16_update,
+                   # both algo configs carry the fused-pipeline knobs...
+                   "reward_norm": args.reward_norm,
+                   "bf16_advantages": args.bf16_advantages}
     over = {k: v for k, v in algo_fields.items() if v is not None}
+    # ...but only PPO has an off-policy correction (A2C's single-epoch
+    # full-batch update consumes each batch once, at its own policy)
+    if args.correction is not None:
+        if cfg.algo != "ppo":
+            sys.exit("--correction selects the PPO advantage pipeline "
+                     "(algos.vtrace); the A2C update has no importance-"
+                     "corrected variant")
+        over["correction"] = args.correction
     if over:
         algo = "ppo" if cfg.algo == "ppo" else "a2c"
         cfg = dataclasses.replace(
@@ -535,6 +572,10 @@ def main(argv: list[str] | None = None) -> dict:
             "rollbacks": args.max_rollbacks is not None,
             "hier": cfg.n_pods > 1,
             "mesh": args.mesh != "off",
+            # resolved AFTER overrides so a preset with
+            # correction="vtrace" is gated the same as the flag
+            "vtrace": cfg.algo == "ppo" and cfg.ppo.correction == "vtrace",
+            "sync": not args.async_run,
         })
     except ModeCombinationError as e:
         sys.exit(str(e))
@@ -598,7 +639,10 @@ def main(argv: list[str] | None = None) -> dict:
         if args.pbt:
             from .experiment import PopulationExperiment
             from .parallel import PBTConfig
-            run_mesh = make_pop_mesh(args.n_pop)
+            # the async population runner owns placement (member stacks
+            # replicated on the actor/learner group meshes), so the
+            # unified pop mesh stays a sync-path construct
+            run_mesh = None if args.async_run else make_pop_mesh(args.n_pop)
             exp = PopulationExperiment.build(
                 cfg, n_pop=args.n_pop, mesh=run_mesh,
                 pbt_cfg=PBTConfig(ready_iters=args.pbt_ready,
