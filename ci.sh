@@ -55,11 +55,14 @@ OBS_DIR=$(mktemp -d /tmp/ci_obs.XXXXXX)
 ASYNC_OBS_DIR=$(mktemp -d /tmp/ci_async_obs.XXXXXX)
 VTRACE_OBS_DIR=$(mktemp -d /tmp/ci_vtrace_obs.XXXXXX)
 SERVE_OBS_DIR=$(mktemp -d /tmp/ci_serve_obs.XXXXXX)
+SOAK_OBS_DIR=$(mktemp -d /tmp/ci_soak_obs.XXXXXX)
 CHAOS_JSON=$(mktemp /tmp/ci_chaos.XXXXXX.json)
 SERVE_JSON=$(mktemp /tmp/ci_serve.XXXXXX.json)
+SOAK_JSON=$(mktemp /tmp/ci_soak.XXXXXX.json)
 TRACE_JSON=$(mktemp /tmp/ci_trace.XXXXXX.json)
 trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$VTRACE_OBS_DIR" \
-    "$SERVE_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON" "$TRACE_JSON"' EXIT
+    "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON" \
+    "$SOAK_JSON" "$TRACE_JSON"' EXIT
 # --trace-spans rides along (ISSUE 11): the flight recorder must not
 # disturb the strict-alarms gate, and the exported Chrome trace must be
 # Perfetto-valid (validated per layer below)
@@ -251,6 +254,62 @@ print("serve smoke ok:", {"p50_ms": round(b["latency_p50_ms"], 3),
                           "fleet_mean_jct": round(fl["mean_jct"], 1)})
 EOF
 
+echo "=== smoke: soak-lite (2 routed engines, deadlines + autoscale, 2 CPU devices) ==="
+# ISSUE 13 acceptance: a short multi-engine soak — 2 mesh-resolved
+# engines, per-request deadlines (shedding armed), adaptive batching,
+# live autoscale advisor — must hold a bounded first-half vs
+# second-half p99 drift, keep ZERO post-warmup recompiles PER ENGINE,
+# export the shed/autoscale/per-engine series on the scrape surface,
+# produce a Perfetto-valid trace with zero torn spans (per-engine
+# lanes included), and pass the same strict-alarms report gate as
+# every other layer.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python -m rlgpuschedule_tpu.serve --config ppo-mlp-synth64 \
+    --engines 2 --soak 6 --rate 150 --deadline-ms 250 \
+    --adaptive-wait --autoscale --bucket 8 --pool-steps 2 \
+    --n-envs 2 --n-nodes 2 --gpus-per-node 4 --window-jobs 16 \
+    --queue-len 4 --horizon 64 \
+    --obs-dir "$SOAK_OBS_DIR" --trace-spans \
+    --metrics-port 0 > "$SOAK_JSON"
+timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.obs.report "$SOAK_OBS_DIR" \
+    --strict-alarms --trace-out "$TRACE_JSON" > /dev/null
+validate_trace "$TRACE_JSON" soak-lite
+python - "$SOAK_JSON" "$SOAK_OBS_DIR" <<'EOF'
+import json, sys
+rep = json.load(open(sys.argv[1]))
+s = rep["soak"]
+assert s["requests"] > 0 and s["served"] > 0, s
+# the steady-state contract, per engine — the fleet aggregate can
+# hide a single recompiling engine behind a quiet sibling
+assert s["per_engine_recompiles"] == [0, 0], s["per_engine_recompiles"]
+assert s["post_warmup_recompiles"] == 0, s
+assert sum(s["per_engine_rows"]) == s["served"], s
+drift = s["p99_drift"]
+assert drift is not None and drift < 3.0, f"p99 drift {drift}"
+assert 1 <= s["engines_active"] <= 2, s
+assert s["serialized_dispatch_cpu"] is True   # honesty bit on this rig
+sc = rep["scrape"]
+assert sc["well_formed"] and sc["status"] == 200, sc
+prom = open(sys.argv[2] + "/metrics.prom").read()
+for series in ("serve_shed_total",
+               "serve_autoscale_desired_engines",
+               "serve_autoscale_resizes_total",
+               "serve_engines_active",
+               'serve_engine_rows_total{engine="0"}',
+               'serve_engine_rows_total{engine="1"}',
+               'serve_recompile_alarms_total{engine="0"}',
+               'serve_recompile_alarms_total{engine="1"}'):
+    assert series in prom, f"missing scrape series: {series}"
+print("soak-lite smoke ok:", {
+    "requests": s["requests"], "shed": s["shed"],
+    "p99_drift": round(drift, 3),
+    "engines_active": s["engines_active"],
+    "autoscale_resizes": s["autoscale_resizes"],
+    "per_engine_rows": s["per_engine_rows"]})
+EOF
+
 echo "=== smoke: sharding (rule-mesh train + PBT-on-mesh, 2 CPU devices) ==="
 # ISSUE 10 acceptance: a rule-sharded --mesh auto run and a PBT run
 # whose population rides the unified mesh's pop axis must both pass the
@@ -262,7 +321,8 @@ PBT_OBS_DIR=$(mktemp -d /tmp/ci_pbt_obs.XXXXXX)
 MESH_JSON=$(mktemp /tmp/ci_mesh.XXXXXX.json)
 PBT_JSON=$(mktemp /tmp/ci_pbt.XXXXXX.json)
 trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$VTRACE_OBS_DIR" \
-    "$SERVE_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON" "$TRACE_JSON" \
+    "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON" \
+    "$SOAK_JSON" "$TRACE_JSON" \
     "$MESH_OBS_DIR" "$PBT_OBS_DIR" "$MESH_JSON" "$PBT_JSON"' EXIT
 # JAX_ENABLE_COMPILATION_CACHE=false on BOTH mesh trains: the persistent
 # compile cache flakily heap-corrupts (malloc_consolidate / segfault,

@@ -164,6 +164,7 @@ MODE_FLAGS: dict[str, str] = {
     "mesh": "--mesh",
     "vtrace": "--correction vtrace",
     "sync": "the synchronous loop (no --async)",
+    "router": "--engines > 1 (multi-engine serving router)",
 }
 
 # THE mode-combination refusal matrix — every pairwise refusal `train`
@@ -213,6 +214,11 @@ MODE_REFUSALS: tuple[tuple[str, str, str], ...] = (
     ("shard_map", "mesh",
      "rule-table shardings are GSPMD in/out_shardings; the axis-name "
      "path wires its own specs in dp.shard_map_train"),
+    ("router", "hier",
+     "the engine router resolves one single-device engine per data-axis "
+     "device; a hierarchical (n_pods > 1) policy's router+placer heads "
+     "have not been validated under per-engine replicated serving — "
+     "serve hierarchical configs single-engine until they are"),
 )
 
 

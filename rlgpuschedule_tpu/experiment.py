@@ -362,7 +362,30 @@ class Experiment:
 
             # one wrapper; jax.jit itself caches one compile per distinct
             # keys length — no second cache layer needed
-            self._fused_jit = jax.jit(many, donate_argnums=(0, 1))
+            if self.mesh is not None:
+                # fused-under-mesh rides the SAME partition-rule table
+                # as the per-step build (not input-inferred shardings,
+                # which would silently fall back to whatever layout the
+                # donated buffers happened to carry): params/opt-state
+                # by the model family's rules, env batch over data,
+                # fanned-out keys replicated — one sharding authority
+                # for both step cadences (ROADMAP residual from PR 9)
+                from .parallel import sharding as shardlib
+                from .parallel.dp import carry_sharding_prefix
+                from .parallel.mesh import env_sharded, replicated
+                rules = shardlib.rules_for(self.cfg)
+                state_sh = shardlib.tree_shardings(self.train_state,
+                                                   rules, self.mesh)
+                env = env_sharded(self.mesh)
+                rep = replicated(self.mesh)
+                carry_sh = carry_sharding_prefix(self.mesh)
+                self._fused_jit = jax.jit(
+                    shardlib.bind_mesh(many, self.mesh),
+                    in_shardings=(state_sh, carry_sh, env, rep, env),
+                    out_shardings=(state_sh, carry_sh, rep),
+                    donate_argnums=(0, 1))
+            else:
+                self._fused_jit = jax.jit(many, donate_argnums=(0, 1))
         self.key, sub = jax.random.split(self.key)
         keys = jax.random.split(sub, iterations)
         self.train_state, self.carry, metrics = self._fused_jit(

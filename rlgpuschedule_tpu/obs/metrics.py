@@ -25,6 +25,27 @@ import re
 from typing import Union
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _label_suffix(labels: "dict[str, str] | None") -> str:
+    """Canonical ``{k="v",...}`` rendering (sorted keys) — the identity
+    of one series within a metric family. Label values may not contain
+    spaces, quotes, or newlines: the exposition stays one
+    whitespace-splittable ``name{labels} value`` line per series."""
+    if not labels:
+        return ""
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k])
+        if not _LABEL_NAME_RE.match(k):
+            raise ValueError(f"bad label name {k!r} (want "
+                             f"{_LABEL_NAME_RE.pattern})")
+        if any(c in v for c in ' "\n\\'):
+            raise ValueError(f"label {k}={v!r}: values must be free of "
+                             f"spaces/quotes/backslashes/newlines")
+        parts.append(f'{k}="{v}"')
+    return "{" + ",".join(parts) + "}"
 
 
 class Counter:
@@ -112,47 +133,67 @@ def _fmt_le(le: float) -> str:
 
 
 class Registry:
-    """Flat name -> metric registry.
+    """Name (+ optional labels) -> metric registry.
 
-    Re-registering an existing name returns the SAME object (call sites
-    in different subsystems may race to declare a shared metric), but a
-    kind mismatch raises — silently returning a counter where a gauge
-    was requested corrupts the snapshot's TYPE line.
+    Re-registering an existing series returns the SAME object (call
+    sites in different subsystems may race to declare a shared metric),
+    but a kind mismatch raises — silently returning a counter where a
+    gauge was requested corrupts the snapshot's TYPE line.
+
+    ``labels`` (PR 13) carves one metric *family* into per-series
+    values — ``serve_engine_dispatches_total{engine="1"}`` — which is
+    how the multi-engine router exports per-engine occupancy without
+    minting a metric name per engine (a scraper aggregates label series
+    with ``sum by``; it cannot aggregate name suffixes). Labeled and
+    unlabeled series may coexist under one family name; the kind and
+    HELP/TYPE header are per family.
     """
 
     def __init__(self):
-        self._metrics: dict[str, Union[Counter, Gauge, Histogram]] = {}
+        # (name, rendered-label-suffix) -> metric; the family header
+        # (kind + help) is resolved from the first-registered series
+        self._metrics: dict[tuple[str, str],
+                            Union[Counter, Gauge, Histogram]] = {}
 
-    def _register(self, cls, name: str, help: str):
+    def _register(self, cls, name: str, help: str,
+                  labels: "dict[str, str] | None" = None):
         if not _NAME_RE.match(name):
             raise ValueError(f"bad metric name {name!r} (want "
                              f"{_NAME_RE.pattern})")
-        existing = self._metrics.get(name)
-        if existing is not None:
-            if not isinstance(existing, cls):
-                raise ValueError(
-                    f"metric {name!r} already registered as "
-                    f"{existing.kind}, not {cls.kind}")
-            return existing
-        metric = cls(name, help)
-        self._metrics[name] = metric
-        return metric
+        key = (name, _label_suffix(labels))
+        existing = self._metrics.get(key)
+        if existing is None:
+            # family kind consistency: any sibling series fixes the kind
+            for (n, _), m in self._metrics.items():
+                if n == name and not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind}, not {cls.kind}")
+            existing = self._metrics[key] = cls(name, help)
+        elif not isinstance(existing, cls):
+            raise ValueError(
+                f"metric {name!r} already registered as "
+                f"{existing.kind}, not {cls.kind}")
+        return existing
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._register(Counter, name, help)
+    def counter(self, name: str, help: str = "",
+                labels: "dict[str, str] | None" = None) -> Counter:
+        return self._register(Counter, name, help, labels)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._register(Gauge, name, help)
+    def gauge(self, name: str, help: str = "",
+              labels: "dict[str, str] | None" = None) -> Gauge:
+        return self._register(Gauge, name, help, labels)
 
     def histogram(self, name: str, help: str = "",
                   buckets: tuple[float, ...] | None = None) -> Histogram:
-        existing = self._metrics.get(name)
+        key = (name, "")
+        existing = self._metrics.get(key)
         if existing is None:
             if not _NAME_RE.match(name):
                 raise ValueError(f"bad metric name {name!r} (want "
                                  f"{_NAME_RE.pattern})")
             h = Histogram(name, help, buckets)
-            self._metrics[name] = h
+            self._metrics[key] = h
             return h
         if not isinstance(existing, Histogram):
             raise ValueError(f"metric {name!r} already registered as "
@@ -166,16 +207,20 @@ class Registry:
         return existing
 
     def render(self) -> str:
-        """Prometheus text exposition: ``# HELP`` / ``# TYPE`` lines,
-        then one value line per counter/gauge or the cumulative
+        """Prometheus text exposition: ``# HELP`` / ``# TYPE`` lines per
+        family, then one value line per series (label-suffixed when the
+        series is labeled) or the cumulative
         ``_bucket``/``_sum``/``_count`` series per histogram;
-        name-sorted for a stable diffable snapshot."""
+        (name, labels)-sorted for a stable diffable snapshot."""
         lines = []
-        for name in sorted(self._metrics):
-            m = self._metrics[name]
-            if m.help:
-                lines.append(f"# HELP {name} {m.help}")
-            lines.append(f"# TYPE {name} {m.kind}")
+        last_family = None
+        for name, suffix in sorted(self._metrics):
+            m = self._metrics[(name, suffix)]
+            if name != last_family:
+                last_family = name
+                if m.help:
+                    lines.append(f"# HELP {name} {m.help}")
+                lines.append(f"# TYPE {name} {m.kind}")
             if isinstance(m, Histogram):
                 for le, acc in m.cumulative():
                     lines.append(
@@ -184,7 +229,7 @@ class Registry:
                 lines.append(f"{name}_sum {m.sum:g}")
                 lines.append(f"{name}_count {m.count}")
             else:
-                lines.append(f"{name} {m.value:g}")
+                lines.append(f"{name}{suffix} {m.value:g}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def write(self, path: str) -> None:

@@ -149,6 +149,114 @@ class Tracer:
         self.bus.emit(SPAN_END, span=name, tid=self._track(),
                       depth=len(stack))
 
+    def lane(self, label: str) -> "TracerLane":
+        """A named VIRTUAL track on this tracer — a dedicated ``tid``
+        that is not any OS thread's, labeled ``label`` in Perfetto.
+
+        The router gives every inference engine its own lane (PR 13):
+        engine spans (``pad``/``dispatch``) land on per-engine tracks,
+        so a routed timeline shows which chip served which batch even
+        though the dispatching happens from whichever pump thread won
+        the request — exactly the track-per-resource (not
+        track-per-thread) layout GPU rows use in Chrome traces. Each
+        call returns a NEW lane (one per engine, allocated at router
+        construction, never per dispatch — tids must stay stable).
+        Disabled tracers return the shared no-op lane."""
+        if not self.enabled:
+            return NULL_LANE
+        with self._lock:
+            # virtual lanes share the tid space with real threads; the
+            # key can never collide with threading.get_ident() values
+            tid = len(self._tids)
+            self._tids[("lane", label, tid)] = tid
+        return TracerLane(self, label, tid)
+
+
+class TracerLane:
+    """One virtual track of a :class:`Tracer` (see :meth:`Tracer.lane`).
+
+    Mirrors the ``span``/``instant`` API; B/E pairing discipline holds
+    per lane via the lane's own depth stack (lock-guarded — concurrent
+    pump threads may dispatch on one engine's lane under queue
+    pressure)."""
+
+    def __init__(self, tracer: Tracer, label: str, tid: int):
+        self._tracer = tracer
+        self.label = label
+        self.tid = tid
+        self._stack: list[str] = []
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self._tracer.enabled
+
+    def span(self, name: str, **attrs: Any) -> Any:
+        if not self._tracer.enabled:
+            return _NULL_SPAN
+        return _LaneSpan(self, name, attrs)
+
+    def instant(self, name: str, **attrs: Any) -> None:
+        if not self._tracer.enabled:
+            return
+        assert self._tracer.bus is not None
+        self._tracer.bus.emit(SPAN_POINT, span=name, tid=self.tid,
+                              **({"attrs": attrs} if attrs else {}))
+
+    def _begin(self, name: str, attrs: dict) -> None:
+        assert self._tracer.bus is not None
+        with self._lock:
+            depth = len(self._stack)
+            self._stack.append(name)
+        self._tracer.bus.emit(SPAN_BEGIN, span=name, tid=self.tid,
+                              depth=depth, thread=self.label,
+                              **({"attrs": attrs} if attrs else {}))
+
+    def _end(self, name: str) -> None:
+        assert self._tracer.bus is not None
+        with self._lock:
+            if self._stack and self._stack[-1] == name:
+                self._stack.pop()
+            depth = len(self._stack)
+        self._tracer.bus.emit(SPAN_END, span=name, tid=self.tid,
+                              depth=depth)
+
+
+class _LaneSpan:
+    """One live span on a virtual lane (same contract as :class:`_Span`)."""
+
+    __slots__ = ("_lane", "_name", "_attrs")
+
+    def __init__(self, lane: TracerLane, name: str, attrs: dict):
+        self._lane = lane
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self) -> "_LaneSpan":
+        self._lane._begin(self._name, self._attrs)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._lane._end(self._name)
+
+
+class _NullLane:
+    """Shared no-op lane for disabled tracers."""
+
+    __slots__ = ()
+    enabled = False
+    label = ""
+    tid = 0
+
+    def span(self, name: str, **attrs: Any) -> Any:
+        return _NULL_SPAN
+
+    def instant(self, name: str, **attrs: Any) -> None:
+        pass
+
+
+NULL_LANE = _NullLane()
+
 
 # the always-available disabled tracer: run loops hold it when no
 # telemetry (or no --trace) is attached, so call sites never branch

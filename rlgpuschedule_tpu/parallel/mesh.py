@@ -101,6 +101,35 @@ def pop_env_sharded(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(POP_AXIS, DATA_AXIS))
 
 
+def serve_devices(mesh: Mesh | None = None) -> list:
+    """The per-engine device walk for multi-engine serving (PR 13): one
+    serving slot per DATA-axis coordinate of the unified mesh, at
+    ``pop=0``/``model=0`` — the same column the single-engine path's
+    "first device" came from, generalized along the axis that carries
+    request-batch parallelism. A deployment that pins the unified mesh
+    to a chip subset moves the whole engine fleet with it.
+
+    ``model > 1`` serving (one engine spanning a model-axis column)
+    is refused for now rather than silently serving from a single
+    shard of a sharded parameter layout."""
+    mesh = mesh if mesh is not None else unified_mesh()
+    if MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1:
+        raise ValueError(
+            f"serve_devices: model axis size {mesh.shape[MODEL_AXIS]} "
+            f"> 1 — per-engine serving resolves one device per data-"
+            f"axis slot and would serve from one shard of a model-"
+            f"sharded layout; model-parallel serving engines are not "
+            f"wired yet")
+    arr = mesh.devices
+    # (pop, data, model) unified layout; tolerate the legacy 2-axis
+    # (pop, data) mesh the dp shims still build
+    if arr.ndim == 3:
+        return list(arr[0, :, 0])
+    if arr.ndim == 2:
+        return list(arr[0, :])
+    return list(arr.reshape(-1))
+
+
 def data_shard_slices(n_rows: int, n_shards: int) -> list[slice]:
     """The contiguous row block each of ``n_shards`` equal data shards
     owns in a ``[n_rows, ...]`` env-batched array under ``env_sharded``'s

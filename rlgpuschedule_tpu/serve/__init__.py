@@ -12,9 +12,16 @@ one-at-a-time evaluation.
   host syncs are production alarms, not silent slowdowns.
 - :mod:`.batching` — the continuous-batching front end:
   :class:`PolicyServer` request queue (coalesce to the next bucket,
-  pad, dispatch, scatter in FIFO order) + the SLO metric surface
+  pad, dispatch, scatter in FIFO order), deadline-aware adaptive
+  batching + load shedding (typed :class:`DeadlineSheddedError`
+  rejections, ``serve_shed_total``), and the SLO metric surface
   (p50/p99 decision latency, decisions/s/chip, queue depth, batch
   occupancy) through the ``obs`` registry.
+- :mod:`.router` — multi-engine scale-out (PR 13):
+  :class:`EngineRouter` resolves one engine per data-axis device of
+  the unified mesh and dispatches least-loaded;
+  :class:`AutoscaleAdvisor` turns the SLO gauges into a desired-engine
+  count the router applies live.
 - :mod:`.fleet` — vmapped fleet replay: one checkpoint vs N seeded
   simulated clusters (optionally under ``sim.faults`` regimes) in a
   single fused-scan dispatch, bit-identical to N sequential
@@ -24,13 +31,17 @@ one-at-a-time evaluation.
 - ``python -m rlgpuschedule_tpu.serve`` — the CLI (``--bench``,
   ``--fleet N``, ``--metrics-port`` live Prometheus scrape endpoint).
 """
-from .batching import (PolicyServer, Reservoir, ServeResult, next_bucket,
-                       pad_batch, scatter_results, stack_requests)
+from .batching import (DeadlineSheddedError, Ewma, PolicyServer, Reservoir,
+                       ServeResult, next_bucket, pad_batch, scatter_results,
+                       stack_requests)
 from .engine import InferenceEngine
 from .fleet import fleet_replay, fleet_windows, sample_fleet_faults
+from .router import AutoscaleAdvisor, EngineRouter, EngineStats
 
 __all__ = [
     "InferenceEngine", "PolicyServer", "Reservoir", "ServeResult",
+    "DeadlineSheddedError", "Ewma",
+    "EngineRouter", "AutoscaleAdvisor", "EngineStats",
     "next_bucket", "pad_batch", "scatter_results", "stack_requests",
     "fleet_replay", "fleet_windows", "sample_fleet_faults",
 ]

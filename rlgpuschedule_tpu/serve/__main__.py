@@ -1,16 +1,26 @@
 """Serving CLI: ``python -m rlgpuschedule_tpu.serve``.
 
-Two modes, composable in one invocation:
+Four modes, composable in one invocation:
 
 - ``--bench``: drive a deterministic synthetic request stream through
   the continuous-batching policy server and report the SLO table —
   p50/p99 decision latency, decisions/s(/chip), batch occupancy, and
   the steady-state contract (zero post-warmup recompiles across
   distinct request sizes within one bucket, CompileCounter-verified).
+- ``--soak SECONDS``: sustained paced load through live dispatcher
+  threads (``--rate``, ``--deadline-ms`` shedding, ``--adaptive-wait``
+  learned batching, ``--autoscale`` advisor loop) reporting p99 drift
+  + shed rate — the ci.sh soak-lite surface.
+- ``--scaleout``: decisions/s + shed rate, 1 engine vs ``--engines``
+  routed engines on the same stream (honest CPU caveat included).
 - ``--fleet N``: vmapped fleet replay — the checkpoint vs N seeded
   simulated clusters in one dispatch (optionally under a
   ``sim.faults`` regime), reporting fleet mean JCT / completion /
   decisions/s.
+
+``--engines N`` serves every mode through the mesh-resolved
+:class:`~.router.EngineRouter` (one engine per data-axis device,
+least-loaded dispatch, per-engine labeled sentinel series).
 
 ``--metrics-port`` exposes the live Prometheus scrape endpoint
 (``obs.serve_http``); ``--obs-dir`` writes the event stream (blessed
@@ -78,6 +88,38 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pool-steps", type=int, default=4,
                    help="bench: env decision steps used to materialize "
                         "the request pool")
+    # multi-engine scale-out (PR 13)
+    p.add_argument("--engines", type=int, default=1,
+                   help="serve through N routed per-device engines (one "
+                        "per data-axis device of the unified mesh; "
+                        "least-loaded dispatch; N=1 keeps the single "
+                        "engine). Refused for hierarchical configs")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-request latency SLO for --soak/--scaleout "
+                        "submissions; requests whose deadline cannot be "
+                        "met are shed with a typed rejection "
+                        "(serve_shed_total)")
+    p.add_argument("--adaptive-wait", action="store_true",
+                   help="learn the partial-bucket hold time from the "
+                        "observed arrival rate (streaming estimator) "
+                        "instead of a fixed max-wait; dispatches early "
+                        "when the head-of-line deadline approaches")
+    p.add_argument("--soak", type=float, default=None, metavar="SECONDS",
+                   help="sustained-load soak: pace --rate requests/s "
+                        "through live dispatcher threads for this long; "
+                        "reports first-half vs second-half p99 drift, "
+                        "shed rate, per-engine rows/recompiles")
+    p.add_argument("--rate", type=float, default=None, metavar="HZ",
+                   help="soak arrival rate (default 200/s)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="with --soak: run the AutoscaleAdvisor loop "
+                        "(SLO gauges -> desired engine count, applied "
+                        "live by the router with hysteresis)")
+    p.add_argument("--scaleout", action="store_true",
+                   help="decisions/s + shed rate vs engine count: "
+                        "isolated 1-engine and --engines-engine arms "
+                        "serving the same stream (CPU caveat: dispatch "
+                        "is serialized there)")
     # fleet mode
     p.add_argument("--fleet", type=int, default=None, metavar="N",
                    help="fleet replay: evaluate the checkpoint against "
@@ -114,12 +156,39 @@ def main(argv: "list[str] | None" = None) -> dict:
     from ..configs import CONFIGS, repro_tuple
     if args.config not in CONFIGS:
         sys.exit(f"unknown config {args.config!r}")
-    if not args.bench and args.fleet is None:
-        sys.exit("nothing to do: pass --bench and/or --fleet N")
+    if (not args.bench and args.fleet is None and args.soak is None
+            and not args.scaleout):
+        sys.exit("nothing to do: pass --bench, --soak S, --scaleout, "
+                 "and/or --fleet N")
     if args.fleet is not None and args.fleet <= 0:
         sys.exit("--fleet must be a positive cluster count")
     if args.bucket <= 0 or (args.bucket & (args.bucket - 1)):
         sys.exit("--bucket must be a positive power of two")
+    if args.engines < 1:
+        sys.exit("--engines must be >= 1")
+    if args.scaleout and args.engines < 2:
+        sys.exit("--scaleout compares 1 engine vs --engines; pass "
+                 "--engines >= 2 with it")
+    if args.soak is not None and args.soak <= 0:
+        sys.exit("--soak must be a positive duration in seconds")
+    if args.rate is not None and args.soak is None:
+        sys.exit("--rate paces --soak submissions; pass --soak S with "
+                 "it (refusing the silent no-op)")
+    if args.rate is not None and args.rate <= 0:
+        sys.exit("--rate must be positive requests/s")
+    if args.autoscale and args.soak is None:
+        sys.exit("--autoscale runs the advisor loop during --soak; "
+                 "pass --soak S with it (refusing the silent no-op)")
+    if args.autoscale and args.engines < 2:
+        sys.exit("--autoscale resizes a multi-engine router; pass "
+                 "--engines >= 2 with it (one engine cannot scale)")
+    if args.deadline_ms is not None and args.deadline_ms <= 0:
+        sys.exit("--deadline-ms must be positive")
+    if (args.deadline_ms is not None and args.soak is None
+            and not args.scaleout):
+        sys.exit("--deadline-ms attaches SLOs to --soak/--scaleout "
+                 "submissions; pass one of them (refusing the silent "
+                 "no-op)")
     if args.fleet_regime is not None and args.fleet is None:
         sys.exit("--fleet-regime configures --fleet replay; pass "
                  "--fleet N with it (refusing the silent no-op)")
@@ -158,6 +227,12 @@ def main(argv: "list[str] | None" = None) -> dict:
              "queue_len": args.queue_len, "horizon": args.horizon,
              "obs_kind": args.obs_kind}.items() if v is not None}
     cfg = dataclasses.replace(cfg, **over)
+    from ..configs import ModeCombinationError, validate_mode_combination
+    try:
+        validate_mode_combination({"router": args.engines > 1,
+                                   "hier": cfg.n_pods > 1})
+    except ModeCombinationError as e:
+        sys.exit(str(e))
 
     import os
 
@@ -166,9 +241,11 @@ def main(argv: "list[str] | None" = None) -> dict:
     from ..obs.trace import NULL_TRACER, Tracer
     from ..utils.platform import enable_compile_cache
     from .batching import PolicyServer
-    from .bench import build_request_pool, run_bench
+    from .bench import (build_request_pool, run_bench, run_scaleout,
+                        run_soak)
     from .engine import InferenceEngine
     from .fleet import fleet_replay, fleet_windows, sample_fleet_faults
+    from .router import AutoscaleAdvisor, EngineRouter
 
     enable_compile_cache()
     repro = repro_tuple(cfg, ckpt_dir=args.ckpt_dir)
@@ -202,18 +279,42 @@ def main(argv: "list[str] | None" = None) -> dict:
             scraper = serve_http(registry, port=args.metrics_port)
             print(f"metrics scrape endpoint: {scraper.url}",
                   file=sys.stderr)
-        engine = InferenceEngine(exp.apply_fn, exp.train_state.params,
-                                 exp.env_params, max_bucket=args.bucket,
-                                 registry=registry, bus=bus,
-                                 tracer=tracer)
-        if args.bench:
+        if args.engines > 1:
+            from ..parallel.mesh import serve_devices
+            avail = len(serve_devices())
+            if args.engines > avail:
+                sys.exit(f"--engines {args.engines} exceeds the "
+                         f"{avail} data-axis device(s) of the unified "
+                         f"mesh (one engine per device)")
+            engine = EngineRouter(exp.apply_fn, exp.train_state.params,
+                                  exp.env_params, max_bucket=args.bucket,
+                                  registry=registry, bus=bus,
+                                  tracer=tracer, n_engines=args.engines)
+            print(f"engine router: {args.engines} engines on "
+                  f"{[str(e.device) for e in engine.engines]}"
+                  + (" (CPU: dispatch serialized)"
+                     if engine.serialized_dispatch() else ""),
+                  file=sys.stderr)
+        else:
+            engine = InferenceEngine(exp.apply_fn,
+                                     exp.train_state.params,
+                                     exp.env_params,
+                                     max_bucket=args.bucket,
+                                     registry=registry, bus=bus,
+                                     tracer=tracer)
+        pool = None
+        if args.bench or args.soak is not None or args.scaleout:
             pool = build_request_pool(exp.apply_fn,
                                       exp.train_state.params,
                                       exp.env_params, exp.traces,
                                       steps=args.pool_steps,
                                       faults=exp.faults)
+        deadline_s = (args.deadline_ms / 1e3
+                      if args.deadline_ms is not None else None)
+        if args.bench:
             server = PolicyServer(engine, registry=registry,
-                                  tracer=tracer)
+                                  tracer=tracer,
+                                  adaptive_wait=args.adaptive_wait)
             report["bench"] = run_bench(engine, server, pool,
                                         rounds=args.rounds,
                                         request_sizes=sizes)
@@ -227,6 +328,56 @@ def main(argv: "list[str] | None" = None) -> dict:
                   f"({b['decisions_per_s_per_chip']:.0f}/chip), "
                   f"post-warmup recompiles: "
                   f"{b['post_warmup_recompiles']}", file=sys.stderr)
+        if args.soak is not None:
+            obs0, mask0 = pool[0]
+            engine.warmup(obs0, mask0)   # every bucket pre-paid
+            server = PolicyServer(engine, registry=registry,
+                                  tracer=tracer,
+                                  adaptive_wait=args.adaptive_wait)
+            advisor = None
+            if args.autoscale:
+                advisor = AutoscaleAdvisor(registry,
+                                           n_max=args.engines,
+                                           initial=args.engines)
+            router = engine if args.engines > 1 else None
+            server.start(dispatchers=args.engines)
+            try:
+                soak = run_soak(
+                    server, pool, duration_s=args.soak,
+                    rate_hz=(args.rate if args.rate is not None
+                             else 200.0),
+                    deadline_s=deadline_s, router=router,
+                    advisor=(advisor if router is not None else None))
+            finally:
+                server.stop()
+            server.slo_snapshot()       # final gauge refresh
+            soak["post_warmup_recompiles"] = \
+                engine.post_warmup_recompiles
+            report["soak"] = soak
+            drift = soak["p99_drift"]
+            print(f"soak: {soak['requests']} requests over "
+                  f"{soak['duration_s']:.1f}s at {soak['rate_hz']:.0f}/s"
+                  f", shed {soak['shed']} "
+                  f"({soak['shed_rate']:.1%}), p99 "
+                  f"{soak['p99_first_half_ms']} -> "
+                  f"{soak['p99_second_half_ms']} ms (drift "
+                  + (f"{drift:.2f}x" if drift is not None else "n/a")
+                  + f"), post-warmup recompiles: "
+                  f"{soak['post_warmup_recompiles']}", file=sys.stderr)
+        if args.scaleout:
+            report["scaleout"] = run_scaleout(
+                exp.apply_fn, exp.train_state.params, exp.env_params,
+                pool, max_bucket=args.bucket, rounds=args.rounds,
+                request_sizes=sizes,
+                engine_counts=(1, args.engines),
+                deadline_s=deadline_s)
+            for arm in report["scaleout"]["arms"]:
+                print(f"scaleout[{arm['engines']} engine(s)]: "
+                      f"{arm['decisions_per_s']:.0f} decisions/s, "
+                      f"shed {arm['shed_rate']:.1%}, rows/engine "
+                      f"{arm['per_engine_rows']}, recompiles "
+                      f"{arm['per_engine_recompiles']}",
+                      file=sys.stderr)
         if args.fleet is not None:
             windows, traces = fleet_windows(cfg, args.fleet,
                                             source=exp.source)

@@ -141,6 +141,53 @@ class ServeResult:
     latency_s: float       # submit -> result, queue wait included
 
 
+class DeadlineSheddedError(RuntimeError):
+    """Typed rejection a shed request's future resolves with.
+
+    Shedding is NEVER a silent drop: the future completes exceptionally
+    with this error, carrying why (``reason``: ``"admission"`` — the
+    predicted wait at submit already exceeded the deadline — or
+    ``"expired"`` — the deadline passed while queued) and the numbers
+    behind the verdict, so a client can retry elsewhere, relax its
+    deadline, or back off — the load-shedding contract from the lost-
+    computation accounting school: reject loudly at the door rather
+    than time out quietly inside."""
+
+    def __init__(self, reason: str, deadline_s: float, waited_s: float,
+                 predicted_wait_s: "float | None" = None):
+        self.reason = reason
+        self.deadline_s = float(deadline_s)
+        self.waited_s = float(waited_s)
+        self.predicted_wait_s = predicted_wait_s
+        pred = (f", predicted wait {predicted_wait_s * 1e3:.1f}ms"
+                if predicted_wait_s is not None else "")
+        super().__init__(
+            f"request shed ({reason}): deadline {deadline_s * 1e3:.1f}ms"
+            f", waited {waited_s * 1e3:.1f}ms{pred}")
+
+
+class Ewma:
+    """Streaming exponentially-weighted mean — the arrival-rate /
+    service-time estimator behind adaptive batching. O(1) memory, no
+    sample window to size; ``alpha`` is the forgetting factor (higher =
+    faster tracking, noisier). ``value`` is ``None`` until the first
+    observation — callers must not act on an unlearned estimate."""
+
+    def __init__(self, alpha: float = 0.2):
+        if not (0.0 < alpha <= 1.0):
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = float(alpha)
+        self.value: "float | None" = None
+        self.count = 0
+
+    def update(self, x: float) -> float:
+        x = float(x)
+        self.count += 1
+        self.value = (x if self.value is None
+                      else self.alpha * x + (1 - self.alpha) * self.value)
+        return self.value
+
+
 @dataclasses.dataclass
 class _Pending:
     obs: Any
@@ -148,6 +195,7 @@ class _Pending:
     stall: int
     t_submit: float
     future: Future
+    deadline_s: "float | None" = None   # relative to t_submit; None = no SLO
 
 
 class PolicyServer:
@@ -184,7 +232,8 @@ class PolicyServer:
 
     def __init__(self, engine, registry=None, latency_window: int = 8192,
                  clock=time.perf_counter, max_wait_s: float | None = None,
-                 tracer=None, sample_seed: int = 0):
+                 tracer=None, sample_seed: int = 0,
+                 adaptive_wait: bool = False):
         from ..obs import Registry
         self.engine = engine
         self.registry = registry if registry is not None else Registry()
@@ -192,6 +241,7 @@ class PolicyServer:
         if max_wait_s is not None and max_wait_s < 0:
             raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
         self.max_wait_s = max_wait_s
+        self.adaptive_wait = bool(adaptive_wait)
         self._clock = clock
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -200,13 +250,23 @@ class PolicyServer:
         # describe the whole run, not its trailing window
         self._latencies = Reservoir(latency_window, seed=sample_seed)
         self._occupancies = Reservoir(latency_window, seed=sample_seed + 1)
-        self._thread: threading.Thread | None = None
+        self._threads: list[threading.Thread] = []
         self._stopped = False
         self._served = 0
         self._t_first: float | None = None
         self._t_last: float | None = None
+        # streaming estimators feeding adaptive batching + admission:
+        # inter-arrival gap (how long a bucket slot takes to fill) and
+        # per-dispatch service time (how long a queued dispatch costs)
+        self._arrival_gap = Ewma(alpha=0.2)
+        self._service_time = Ewma(alpha=0.2)
+        self._t_prev_submit: "float | None" = None
         self._requests = self.registry.counter(
             "serve_requests_total", "scheduling requests submitted")
+        self._shed = self.registry.counter(
+            "serve_shed_total",
+            "requests rejected with a typed deadline rejection "
+            "(admission + in-queue expiry)")
         self._dispatches = self.registry.counter(
             "serve_dispatches_total", "coalesced batch dispatches")
         self._padded = self.registry.counter(
@@ -226,21 +286,100 @@ class PolicyServer:
             "aggregatable across ranks/restarts, unlike percentile "
             "gauges)")
 
-    def submit(self, obs: Any, mask: Any, stall: int = 0) -> Future:
+    def submit(self, obs: Any, mask: Any, stall: int = 0,
+               deadline_s: "float | None" = None) -> Future:
         """Enqueue one scheduling request (host pytrees, NO leading batch
         axis). ``stall`` is the client's consecutive-zero-dt count for
-        the stall gate (preemptive configs; 0 = gate disengaged)."""
+        the stall gate (preemptive configs; 0 = gate disengaged).
+
+        ``deadline_s`` is the request's latency SLO, relative to submit.
+        A deadlined request is subject to **load shedding**: if the
+        predicted queue wait at submit time (queued dispatches ahead ×
+        learned service time) already exceeds the deadline, or the
+        deadline expires while queued, the returned future resolves
+        exceptionally with :class:`DeadlineSheddedError` — typed, never
+        a silent drop — and ``serve_shed_total`` counts it. Admission
+        only rejects once the service-time estimator has observations
+        (a cold server admits everything rather than guessing)."""
+        now = self._clock()
         fut: Future = Future()
         req = _Pending(obs=obs, mask=mask, stall=int(stall),
-                       t_submit=self._clock(), future=fut)
+                       t_submit=now, future=fut,
+                       deadline_s=(None if deadline_s is None
+                                   else float(deadline_s)))
         with self._wake:
             if self._stopped:
                 raise RuntimeError("PolicyServer is stopped")
-            self._pending.append(req)
             self._requests.inc()
+            if self._t_prev_submit is not None:
+                self._arrival_gap.update(now - self._t_prev_submit)
+            self._t_prev_submit = now
+            svc = self._service_time.value
+            if (req.deadline_s is not None and svc is not None):
+                # dispatches ahead of this request if it joins the queue,
+                # itself included — each costs ~one learned service time
+                ahead = -(-(len(self._pending) + 1)
+                          // self.engine.max_bucket)
+                predicted = ahead * svc
+                if predicted > req.deadline_s:
+                    self._shed.inc()
+                    fut.set_exception(DeadlineSheddedError(
+                        "admission", req.deadline_s, waited_s=0.0,
+                        predicted_wait_s=predicted))
+                    self.tracer.instant("shed", reason="admission")
+                    return fut
+            self._pending.append(req)
             self._wake.notify()
         self.tracer.instant("enqueue", stall=int(stall))
         return fut
+
+    def _shed_expired(self, now: float) -> None:
+        """Drop queued requests whose deadline already passed (called
+        under ``self._lock``); their futures resolve with the typed
+        rejection. Head-first scan is NOT enough: deadlines are
+        per-request, so a generous-deadline head can hide an expired
+        tail."""
+        if not any(r.deadline_s is not None for r in self._pending):
+            return
+        keep: collections.deque[_Pending] = collections.deque()
+        for r in self._pending:
+            if (r.deadline_s is not None
+                    and now - r.t_submit > r.deadline_s):
+                self._shed.inc()
+                if not r.future.cancelled():
+                    r.future.set_exception(DeadlineSheddedError(
+                        "expired", r.deadline_s,
+                        waited_s=now - r.t_submit))
+                self.tracer.instant("shed", reason="expired")
+            else:
+                keep.append(r)
+        self._pending = keep
+
+    def _effective_wait(self) -> "float | None":
+        """The partial-bucket hold time for THIS pump (called under
+        ``self._lock``, queue non-empty). Static mode returns the
+        constructor knob. Adaptive mode learns it: hold for the
+        estimated time to FILL the bucket at the observed arrival rate
+        (waiting longer than that buys nothing), clipped to the
+        head-of-line deadline slack (dispatch a partial bucket rather
+        than shed the head), and capped by ``max_wait_s`` when given."""
+        if not self.adaptive_wait:
+            return self.max_wait_s
+        waits = []
+        if self.max_wait_s is not None:
+            waits.append(self.max_wait_s)
+        gap = self._arrival_gap.value
+        if gap is not None:
+            free = max(self.engine.max_bucket - len(self._pending), 0)
+            waits.append(gap * free)
+        now = self._clock()
+        slacks = [r.t_submit + r.deadline_s - now
+                  for r in self._pending if r.deadline_s is not None]
+        if slacks:
+            # keep one learned service time in hand for the dispatch
+            svc = self._service_time.value or 0.0
+            waits.append(max(min(slacks) - svc, 0.0))
+        return min(waits) if waits else None
 
     def pump(self, max_wait_s: float | None = None) -> int:
         """Drain one coalesced batch: pop up to ``engine.max_bucket``
@@ -248,26 +387,42 @@ class PolicyServer:
         results to their futures. Returns the number of requests served
         (0 = queue was empty).
 
-        ``max_wait_s`` (default: the constructor's knob; ``None`` = no
+        ``max_wait_s`` (default: the constructor's policy; ``None`` = no
         wait) is the batching deadline: a PARTIAL bucket holds off
-        dispatching until either the bucket fills or the OLDEST pending
-        request has waited that long — trading a bounded latency floor
-        for occupancy (the classic continuous-batching knob). ``0``
-        keeps the dispatch-whatever-is-pending behavior while still
-        being explicit about it. A :meth:`stop` drain cuts the wait
-        short so shutdown never hangs on a sparse queue."""
-        if max_wait_s is None:
-            max_wait_s = self.max_wait_s
+        dispatching until either the bucket fills or the batching
+        deadline passes — trading a bounded latency floor for occupancy
+        (the classic continuous-batching knob). ``0`` keeps the
+        dispatch-whatever-is-pending behavior while still being
+        explicit about it. With ``adaptive_wait`` the hold time is
+        LEARNED per pump (:meth:`_effective_wait`): the estimated
+        bucket fill time at the observed arrival rate, cut short when
+        the head-of-line deadline slack runs out — the deadline-aware
+        partial-bucket dispatch. Expired deadlines shed before and
+        after the hold (:meth:`_shed_expired`). A :meth:`stop` drain
+        cuts the wait short so shutdown never hangs on a sparse
+        queue."""
         with self._lock:
-            if max_wait_s is not None and self._pending:
-                deadline = self._pending[0].t_submit + max_wait_s
-                with self.tracer.span("bucket_wait"):
-                    while (len(self._pending) < self.engine.max_bucket
-                           and not self._stopped):
-                        remaining = deadline - self._clock()
-                        if remaining <= 0:
-                            break
-                        self._wake.wait(timeout=remaining)
+            self._shed_expired(self._clock())
+            if self._pending:
+                wait = (max_wait_s if max_wait_s is not None
+                        else self._effective_wait())
+                if wait is not None:
+                    # static mode anchors at the head's submit time
+                    # (total head wait bounded by the knob); adaptive
+                    # mode anchors NOW — its estimate already folds in
+                    # the head's remaining slack
+                    anchor = (self._clock()
+                              if max_wait_s is None and self.adaptive_wait
+                              else self._pending[0].t_submit)
+                    deadline = anchor + wait
+                    with self.tracer.span("bucket_wait"):
+                        while (len(self._pending) < self.engine.max_bucket
+                               and not self._stopped):
+                            remaining = deadline - self._clock()
+                            if remaining <= 0:
+                                break
+                            self._wake.wait(timeout=remaining)
+                    self._shed_expired(self._clock())
             batch = [self._pending.popleft()
                      for _ in range(min(len(self._pending),
                                         self.engine.max_bucket))]
@@ -275,6 +430,7 @@ class PolicyServer:
         if not batch:
             return 0
         n = len(batch)
+        t_disp = self._clock()
         try:
             with self.tracer.span("serve_batch", n=n):
                 with self.tracer.span("stack"):
@@ -290,31 +446,44 @@ class PolicyServer:
                 if not r.future.cancelled():
                     r.future.set_exception(e)
             raise
-        self._dispatches.inc()
-        self._padded.inc(bucket - n)
-        self._occupancy.set(n / bucket)
-        self._occupancies.append(n / bucket)
+        # accounting under the lock: concurrent dispatcher threads
+        # (start(dispatchers=N) over a router) share every reservoir,
+        # counter, and estimator below
+        lats = [now - r.t_submit for r in batch]
         with self._lock:
+            self._service_time.update(now - t_disp)
+            self._dispatches.inc()
+            self._padded.inc(bucket - n)
+            self._occupancy.set(n / bucket)
+            self._occupancies.append(n / bucket)
             if self._t_first is None:
                 self._t_first = min(r.t_submit for r in batch)
-            self._t_last = now
+            self._t_last = now if self._t_last is None else max(
+                self._t_last, now)
             self._served += n
-        for r, a in zip(batch, per_req):
-            lat = now - r.t_submit
-            self._latencies.append(lat)
-            self._latency_hist.observe(lat)
+            for lat in lats:
+                self._latencies.append(lat)
+                self._latency_hist.observe(lat)
+            self._sample_window.set(len(self._latencies))
+        for r, a, lat in zip(batch, per_req, lats):
             r.future.set_result(ServeResult(action=a, latency_s=lat))
-        self._sample_window.set(len(self._latencies))
         return n
 
     # ---- live dispatcher thread --------------------------------------
 
-    def start(self) -> None:
-        """Start the background dispatcher: pump whenever requests are
+    def start(self, dispatchers: int = 1) -> None:
+        """Start the background dispatchers: pump whenever requests are
         pending (continuous batching — each dispatch coalesces whatever
-        arrived while the previous one ran)."""
-        if self._thread is not None:
+        arrived while the previous one ran). ``dispatchers > 1`` keeps
+        that many pumps in flight at once so a multi-engine router can
+        run its engines concurrently; over a single engine extra
+        dispatchers only shrink batch occupancy (and the router is the
+        layer that owns device-level thread safety — see
+        ``serve.router.EngineRouter``)."""
+        if self._threads:
             raise RuntimeError("dispatcher already running")
+        if dispatchers < 1:
+            raise ValueError(f"dispatchers must be >= 1, got {dispatchers}")
         self._stopped = False
 
         def loop():
@@ -326,22 +495,24 @@ class PolicyServer:
                         return
                 self.pump()
 
-        self._thread = threading.Thread(target=loop,
-                                        name="serve-dispatcher",
-                                        daemon=True)
-        self._thread.start()
+        for i in range(dispatchers):
+            t = threading.Thread(target=loop,
+                                 name=f"serve-dispatcher-{i}",
+                                 daemon=True)
+            self._threads.append(t)
+            t.start()
 
     def stop(self) -> None:
-        """Stop the dispatcher after draining the queue. Submits are
+        """Stop the dispatchers after draining the queue. Submits are
         refused while the drain is in flight; once stopped the server
         is back in inline mode (submit-then-:meth:`pump`) and
         :meth:`start` may be called again."""
         with self._wake:
             self._stopped = True
             self._wake.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
-            self._thread = None
+        for t in self._threads:
+            t.join(timeout=30)
+        self._threads = []
         with self._wake:
             self._stopped = False
 

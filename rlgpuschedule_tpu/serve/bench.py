@@ -11,9 +11,21 @@ Requests are real observations: a pool is built by resetting the
 config's env windows and stepping them a few decisions under the same
 greedy policy being served, so the benched batches look like live
 cluster snapshots, not zeros.
+
+PR 13 adds the scale-out half: :func:`run_scaleout` measures
+decisions/s + shed rate vs engine count (1 vs N routed engines, each
+arm an isolated router + registry), and :func:`run_soak` drives a
+sustained paced request stream through a live dispatcher fleet — the
+p99-drift / zero-torn-span / zero-recompile surface the ci.sh
+soak-lite stage asserts on. Both carry the
+``serialized_dispatch_cpu`` honesty bit: on the CPU backend the router
+serializes device work (XLA:CPU thread-safety), so decisions/s does
+NOT scale with engines there — the numbers prove the routing and
+accounting, not CPU wall-clock scaling.
 """
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import jax
@@ -112,3 +124,164 @@ def run_bench(engine, server, pool: "list[tuple[Any, Any]]",
         **snap,
         "requests": len(results),
     }
+
+
+def run_scaleout(apply_fn, net_params: Any, env_params: Any,
+                 pool: "list[tuple[Any, Any]]", *, max_bucket: int,
+                 rounds: int = 24,
+                 request_sizes: "tuple[int, ...] | None" = None,
+                 engine_counts: "tuple[int, ...]" = (1, 2),
+                 deadline_s: "float | None" = None) -> dict:
+    """Decisions/s + shed rate vs engine count: one isolated arm per
+    count in ``engine_counts`` (fresh router + registry + server, so
+    arms share nothing), each serving the SAME deterministic request
+    stream through ``engines`` live dispatcher threads. Per-arm output
+    carries per-engine row shares and recompile counts; the top level
+    carries the CPU-serialization caveat (module docstring)."""
+    from ..obs import Registry
+    from .batching import DeadlineSheddedError, PolicyServer
+    from .router import EngineRouter
+
+    if request_sizes is None:
+        request_sizes = default_request_sizes(max_bucket)
+    request_sizes = tuple(int(s) for s in request_sizes)
+    obs0, mask0 = pool[0]
+    arms = []
+    serialized = None
+    for k in engine_counts:
+        reg = Registry()
+        router = EngineRouter(apply_fn, net_params, env_params,
+                              max_bucket=max_bucket, registry=reg,
+                              n_engines=int(k))
+        serialized = router.serialized_dispatch()
+        buckets = tuple(sorted({router.bucket_for(s)
+                                for s in request_sizes}))
+        router.warmup(obs0, mask0, buckets=buckets)
+        server = PolicyServer(router, registry=reg)
+        server.start(dispatchers=int(k))
+        futures, shed, cursor = [], 0, 0
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            for _ in range(request_sizes[r % len(request_sizes)]):
+                obs, mask = pool[cursor % len(pool)]
+                futures.append(server.submit(obs, mask,
+                                             deadline_s=deadline_s))
+                cursor += 1
+        for f in futures:
+            try:
+                f.result(timeout=120)
+            except DeadlineSheddedError:
+                shed += 1
+        wall = time.perf_counter() - t0
+        server.stop()
+        total_rows = sum(s.rows for s in router.stats()) or 1
+        arms.append({
+            "engines": int(k),
+            "requests": len(futures),
+            "served": len(futures) - shed,
+            "shed": shed,
+            "shed_rate": shed / len(futures),
+            "decisions_per_s": (len(futures) - shed) / wall,
+            "wall_s": wall,
+            "per_engine_rows": [s.rows for s in router.stats()],
+            "per_engine_row_share": [s.rows / total_rows
+                                     for s in router.stats()],
+            "per_engine_dispatches": [s.dispatches
+                                      for s in router.stats()],
+            "per_engine_occupancy": [s.occupancy
+                                     for s in router.stats()],
+            "per_engine_recompiles": router.per_engine_recompiles(),
+        })
+    return {
+        "engine_counts": [int(k) for k in engine_counts],
+        "rounds": rounds,
+        "request_sizes": list(request_sizes),
+        "deadline_s": deadline_s,
+        "serialized_dispatch_cpu": bool(serialized),
+        "caveat": ("CPU backend serializes device dispatch behind one "
+                   "lock (XLA:CPU thread-safety) — decisions/s does not "
+                   "scale with engines here; routing/occupancy/shed "
+                   "accounting is what this measures"
+                   if serialized else None),
+        "arms": arms,
+    }
+
+
+def run_soak(server, pool: "list[tuple[Any, Any]]", *,
+             duration_s: float = 6.0, rate_hz: float = 200.0,
+             deadline_s: "float | None" = None, router=None,
+             advisor=None, advisor_every_s: float = 0.5) -> dict:
+    """Sustained-load soak through a RUNNING server (caller started the
+    dispatchers): pace submissions at ``rate_hz`` for ``duration_s``,
+    optionally attaching a per-request ``deadline_s`` (shedding active)
+    and an autoscale loop (every ``advisor_every_s``: refresh the SLO
+    gauges, let ``advisor`` vote, apply to ``router``). Reports
+    first-half vs second-half p99 — the drift surface the soak-lite CI
+    stage bounds (an unbounded queue or a leak shows up as second-half
+    p99 runaway)."""
+    from .batching import DeadlineSheddedError
+
+    if advisor is not None and router is None:
+        raise ValueError("autoscale soak needs the router to apply "
+                         "advisor votes to")
+    interval = 1.0 / float(rate_hz)
+    futures = []
+    cursor = 0
+    resizes = 0
+    t_start = time.perf_counter()
+    next_t = t_start
+    next_tick = t_start + advisor_every_s
+    while time.perf_counter() - t_start < duration_s:
+        obs, mask = pool[cursor % len(pool)]
+        futures.append(server.submit(obs, mask, deadline_s=deadline_s))
+        cursor += 1
+        now = time.perf_counter()
+        if advisor is not None and now >= next_tick:
+            server.slo_snapshot()       # refresh the gauges it reads
+            before = advisor.desired
+            router.apply_autoscale(advisor)
+            resizes += int(advisor.desired != before)
+            next_tick += advisor_every_s
+        next_t += interval
+        sleep = next_t - time.perf_counter()
+        if sleep > 0:
+            time.sleep(sleep)
+    lat_s: "list[float | None]" = []
+    shed = 0
+    for f in futures:
+        try:
+            lat_s.append(f.result(timeout=120).latency_s)
+        except DeadlineSheddedError:
+            shed += 1
+            lat_s.append(None)
+    wall = time.perf_counter() - t_start
+
+    def p99_ms(xs):
+        xs = [x for x in xs if x is not None]
+        return (float(np.percentile(np.asarray(xs), 99) * 1e3)
+                if xs else None)
+
+    half = len(lat_s) // 2
+    p99_a, p99_b = p99_ms(lat_s[:half]), p99_ms(lat_s[half:])
+    out = {
+        "requests": len(futures),
+        "served": len(futures) - shed,
+        "shed": shed,
+        "shed_rate": shed / max(len(futures), 1),
+        "duration_s": wall,
+        "rate_hz": rate_hz,
+        "deadline_s": deadline_s,
+        "p99_first_half_ms": p99_a,
+        "p99_second_half_ms": p99_b,
+        "p99_drift": (p99_b / p99_a
+                      if p99_a and p99_b and p99_a > 0 else None),
+        "autoscale_resizes": resizes if advisor is not None else None,
+    }
+    if router is not None:
+        out["per_engine_rows"] = [s.rows for s in router.stats()]
+        out["per_engine_occupancy"] = [s.occupancy
+                                       for s in router.stats()]
+        out["per_engine_recompiles"] = router.per_engine_recompiles()
+        out["engines_active"] = router.n_active
+        out["serialized_dispatch_cpu"] = router.serialized_dispatch()
+    return out

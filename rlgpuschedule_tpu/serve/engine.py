@@ -55,7 +55,7 @@ class InferenceEngine:
     def __init__(self, apply_fn, net_params: Any, env_params: Any = None,
                  max_bucket: int = 256, registry=None, bus=None,
                  strict: bool = False, stall_gate: bool = True,
-                 tracer=None):
+                 tracer=None, device=None, engine_id: "int | None" = None):
         from ..obs import Registry
         if max_bucket <= 0 or (max_bucket & (max_bucket - 1)):
             raise ValueError(f"max_bucket must be a positive power of "
@@ -67,25 +67,34 @@ class InferenceEngine:
         self.tracer = tracer if tracer is not None else NULL_TRACER
         # placement resolved from the shared unified mesh (same device
         # walk as train/async) instead of jax's implicit default device:
-        # the engine serves from a one-device submesh — the mesh's first
-        # device — so a deployment that pins the unified mesh to a chip
-        # subset moves serving with it. Multi-engine serving (one engine
-        # per mesh column + a router) is the named next layer (ROADMAP).
+        # a lone engine serves from the mesh's first device; the router
+        # (serve.router, PR 13) passes one data-axis device per engine
+        # (parallel.mesh.serve_devices), so a deployment that pins the
+        # unified mesh to a chip subset moves the whole fleet with it.
         from ..parallel.mesh import unified_mesh
-        self._serve_sharding = jax.sharding.SingleDeviceSharding(
-            unified_mesh().devices.flatten()[0])
+        if device is None:
+            device = unified_mesh().devices.flatten()[0]
+        self.device = device
+        self.engine_id = engine_id
+        self._serve_sharding = jax.sharding.SingleDeviceSharding(device)
         self._params = jax.device_put(net_params, self._serve_sharding)
         pre = (preempt_slice(env_params)
                if stall_gate and env_params is not None else None)
         thresh = stall_threshold(env_params) if pre is not None else 0
         self._has_stall_gate = pre is not None
         self._warmed: set[int] = set()
+        # engine_id labels the sentinel series so N routed engines keep
+        # N separate counters in ONE registry (the per-engine
+        # zero-recompile contract is per engine, not fleet-aggregate)
+        labels = ({"engine": str(engine_id)}
+                  if engine_id is not None else None)
         self._recompiles = self.registry.counter(
             "serve_recompile_alarms_total",
-            "post-warmup dispatches that traced or compiled")
+            "post-warmup dispatches that traced or compiled",
+            labels=labels)
         self._compiles = self.registry.counter(
             "serve_bucket_compiles_total",
-            "blessed per-bucket warmup compiles")
+            "blessed per-bucket warmup compiles", labels=labels)
         # ONE jit per engine, built here and reused every dispatch (the
         # jsan recompile-hazard discipline); request buffers are donated
         # — they are per-dispatch transients, and donation lets XLA

@@ -1,0 +1,382 @@
+"""Multi-engine serving scale-out: the mesh-resolved engine router.
+
+Podracer's pod-carving recipe (PAPERS.md: arXiv 2104.06272) applied to
+the serving tier: "millions of users" cannot funnel through one chip,
+so the unified ``Mesh(pop × data × model)`` is carved into one
+:class:`~.engine.InferenceEngine` per DATA-axis device
+(:func:`..parallel.mesh.serve_devices` — the axis that carries
+request-batch parallelism), each with its own donated request buffers,
+its own blessed per-bucket warmup, and its own CompileCounter/
+transfer-guard sentinels (per-engine labeled series in ONE registry:
+``serve_recompile_alarms_total{engine="i"}``). The router dispatches
+each coalesced batch to the **least-loaded** active engine, so
+decisions/s scales with engines instead of saturating one device.
+
+Correctness contract (tests/test_router.py): every engine is the SAME
+single-device program — identical params, identical jit, identical
+decision function — so a routed fleet of N engines is **bit-identical**
+to a single engine fed the same request stream, regardless of which
+engine served which batch (batch-composition invariance of the policy
+is pinned separately in tests/test_serve.py). That is what makes the
+scale-out testable on the forced-virtual-CPU rig.
+
+Thread safety: the router is the layer that owns device-level dispatch
+concurrency. On the CPU backend all N "devices" share one XLA backend
+whose compile cache and donation paths are NOT safe under concurrent
+execute threads (the async_engine PR-8 finding), so CPU routing
+serializes device work behind one dispatch lock — routing still
+balances rows across engines (the accounting, warmup isolation, and
+per-engine sentinels are all real), but wall-clock decisions/s does
+not scale on CPU. On real accelerator backends the lock degrades to a
+no-op and engines dispatch concurrently. Bench output carries this
+caveat honestly (``serialized_dispatch_cpu``).
+
+The autoscale loop closes here too: :class:`AutoscaleAdvisor` turns
+the SLO surface the server already exports (p99, queue depth,
+occupancy, shed rate) into a desired-engine-count signal with
+hysteresis, and :meth:`EngineRouter.set_active` applies it live —
+spin-up re-warms a drained engine with blessed compiles before it
+takes traffic, drain simply stops routing to it (inflight work
+completes; buckets stay warm for the next spin-up).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Any
+
+import jax
+
+from ..obs.trace import NULL_TRACER
+from ..parallel.mesh import serve_devices
+from .engine import InferenceEngine
+
+
+@dataclasses.dataclass
+class EngineStats:
+    """Point-in-time per-engine routing state (:meth:`EngineRouter.stats`)."""
+    engine_id: int
+    device: str            # str(device) — placement, for humans/logs
+    active: bool
+    inflight: int          # dispatches currently on the device
+    dispatches: int        # completed dispatches routed here, lifetime
+    rows: int              # real request rows served, lifetime
+    slots: int             # bucket rows dispatched (rows + padding)
+    recompiles: int        # post-warmup recompile alarms (must stay 0)
+
+    @property
+    def occupancy(self) -> "float | None":
+        """Lifetime mean occupancy: real rows / bucket slots."""
+        return self.rows / self.slots if self.slots else None
+
+
+class EngineRouter:
+    """N per-device inference engines behind one ``decide()``.
+
+    Drop-in for a single :class:`~.engine.InferenceEngine` everywhere
+    the :class:`~.batching.PolicyServer` touches one (``decide``,
+    ``max_bucket``, ``bucket_for``, ``warmup``,
+    ``post_warmup_recompiles``, ``warmed_buckets``), so the batching
+    front end needs no interface change — point the server at a router
+    and ``start(dispatchers=N)`` to keep N dispatches in flight.
+
+    Dispatch policy: **least-loaded** — the active engine with the
+    fewest inflight dispatches, ties broken by fewest lifetime rows
+    served, then lowest id (deterministic; fairness is property-tested).
+    Engine selection and load accounting sit behind the router's own
+    lock; device work sits behind the CPU-only dispatch lock (module
+    docstring).
+    """
+
+    def __init__(self, apply_fn, net_params: Any, env_params: Any = None,
+                 max_bucket: int = 256, registry=None, bus=None,
+                 strict: bool = False, stall_gate: bool = True,
+                 tracer=None, n_engines: "int | None" = None, mesh=None):
+        from ..obs import Registry
+        self.registry = registry if registry is not None else Registry()
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        devices = serve_devices(mesh)
+        if n_engines is None:
+            n_engines = len(devices)
+        if not 1 <= n_engines <= len(devices):
+            raise ValueError(
+                f"n_engines={n_engines} must be in [1, {len(devices)}] "
+                f"(one engine per data-axis device of the unified mesh)")
+        # one engine per data-axis device, each on its own trace lane so
+        # pad/dispatch spans land on per-engine tracks in the timeline
+        self.engines = [
+            InferenceEngine(
+                apply_fn, net_params, env_params, max_bucket=max_bucket,
+                registry=self.registry, bus=bus, strict=strict,
+                stall_gate=stall_gate,
+                tracer=self.tracer.lane(f"engine-{i}"),
+                device=devices[i], engine_id=i)
+            for i in range(n_engines)
+        ]
+        self.max_bucket = max_bucket
+        # PR-8 finding: XLA:CPU's backend is shared by all virtual CPU
+        # devices and is unsafe under concurrent execute threads with
+        # donation — serialize device work on CPU, free elsewhere
+        self._on_cpu = devices[0].platform == "cpu"
+        self._device_lock = (threading.Lock() if self._on_cpu
+                             else contextlib.nullcontext())
+        self._lock = threading.Lock()
+        self._active = [True] * n_engines
+        self._inflight = [0] * n_engines
+        self._rows = [0] * n_engines
+        self._slots = [0] * n_engines
+        self._dispatch_counts = [0] * n_engines
+        self._example: "tuple[Any, Any] | None" = None
+        self._eng_dispatches = [
+            self.registry.counter(
+                "serve_engine_dispatches_total",
+                "batch dispatches routed to this engine",
+                labels={"engine": str(i)})
+            for i in range(n_engines)]
+        self._eng_rows = [
+            self.registry.counter(
+                "serve_engine_rows_total",
+                "real request rows served by this engine",
+                labels={"engine": str(i)})
+            for i in range(n_engines)]
+        self._eng_occupancy = [
+            self.registry.gauge(
+                "serve_engine_occupancy",
+                "real rows / bucket rows of this engine's last dispatch",
+                labels={"engine": str(i)})
+            for i in range(n_engines)]
+        self._g_total = self.registry.gauge(
+            "serve_engines_total", "engines resolved from the mesh")
+        self._g_active = self.registry.gauge(
+            "serve_engines_active", "engines currently taking traffic")
+        self._g_total.set(n_engines)
+        self._g_active.set(n_engines)
+
+    # ---- engine-interface parity -------------------------------------
+
+    @property
+    def n_engines(self) -> int:
+        return len(self.engines)
+
+    @property
+    def n_active(self) -> int:
+        with self._lock:
+            return sum(self._active)
+
+    @property
+    def post_warmup_recompiles(self) -> int:
+        """Fleet-aggregate recompile alarms; :meth:`per_engine_recompiles`
+        carries the per-engine contract (each must be 0 on its own)."""
+        return sum(e.post_warmup_recompiles for e in self.engines)
+
+    def per_engine_recompiles(self) -> "list[int]":
+        return [e.post_warmup_recompiles for e in self.engines]
+
+    @property
+    def warmed_buckets(self) -> "tuple[int, ...]":
+        return self.engines[0].warmed_buckets
+
+    def bucket_for(self, n: int) -> int:
+        return self.engines[0].bucket_for(n)
+
+    def serialized_dispatch(self) -> bool:
+        """True when device work is serialized behind the CPU dispatch
+        lock — the honesty bit the bench carries next to its
+        decisions/s-vs-engines numbers."""
+        return self._on_cpu
+
+    # ---- dispatch ----------------------------------------------------
+
+    def _acquire(self) -> int:
+        """Pick the least-loaded active engine and book an inflight slot
+        (fewest inflight, then fewest lifetime rows, then lowest id)."""
+        with self._lock:
+            candidates = [i for i in range(len(self.engines))
+                          if self._active[i]]
+            if not candidates:
+                raise RuntimeError("no active engines")
+            eid = min(candidates,
+                      key=lambda i: (self._inflight[i], self._rows[i], i))
+            self._inflight[eid] += 1
+            return eid
+
+    def _release(self, eid: int, rows: int, bucket: "int | None") -> None:
+        with self._lock:
+            self._inflight[eid] -= 1
+            if bucket is not None:        # dispatch actually completed
+                self._rows[eid] += rows
+                self._slots[eid] += bucket
+                self._dispatch_counts[eid] += 1
+                self._eng_dispatches[eid].inc()
+                self._eng_rows[eid].inc(rows)
+                self._eng_occupancy[eid].set(rows / bucket)
+
+    def decide(self, obs: Any, mask: Any, stall=None) -> "tuple[Any, int]":
+        """One routed batch decision — same signature and result as
+        :meth:`.engine.InferenceEngine.decide` (bit-identical, per the
+        module-docstring contract)."""
+        n = int(jax.tree.leaves(obs)[0].shape[0])
+        eid = self._acquire()
+        bucket = None
+        try:
+            with self._device_lock:
+                actions, bucket = self.engines[eid].decide(obs, mask, stall)
+        finally:
+            self._release(eid, n, bucket)
+        return actions, bucket
+
+    # ---- warmup / live resize ----------------------------------------
+
+    def warmup(self, example_obs: Any, example_mask: Any,
+               buckets: "tuple[int, ...]" = ()) -> "tuple[int, ...]":
+        """Warm every ACTIVE engine's buckets (blessed compiles), and
+        remember the example so :meth:`set_active` can warm engines it
+        spins up later. Returns the buckets the first engine warmed."""
+        self._example = (example_obs, example_mask)
+        done: "tuple[int, ...]" = ()
+        for i, e in enumerate(self.engines):
+            with self._lock:
+                active = self._active[i]
+            if not active:
+                continue
+            with self._device_lock:
+                out = e.warmup(example_obs, example_mask, buckets)
+            if i == 0:
+                done = out
+        return done
+
+    def set_active(self, k: int) -> int:
+        """Resize the serving fleet to the first ``k`` engines (clamped
+        to ``[1, n_engines]``). Spin-up warms a cold engine FIRST (its
+        compiles stay blessed — it takes no traffic until warm); drain
+        just stops routing (inflight dispatches finish; the engine's
+        warmed buckets are kept, so re-activation is free). Returns the
+        applied count."""
+        k = max(1, min(int(k), len(self.engines)))
+        with self._lock:
+            need_warm = [i for i in range(k)
+                         if not self._active[i]
+                         and self.engines[i].warmed_buckets == ()]
+        if self._example is not None:
+            for i in need_warm:
+                with self._device_lock:
+                    self.engines[i].warmup(*self._example)
+        with self._lock:
+            for i in range(len(self.engines)):
+                self._active[i] = i < k
+            self._g_active.set(k)
+        return k
+
+    def apply_autoscale(self, advisor: "AutoscaleAdvisor") -> int:
+        """One autoscale tick: let ``advisor`` vote on the SLO surface,
+        apply the (hysteresis-filtered) desired engine count live.
+        Returns the active count after application."""
+        return self.set_active(advisor.observe())
+
+    # ---- introspection -----------------------------------------------
+
+    def stats(self) -> "list[EngineStats]":
+        with self._lock:
+            return [EngineStats(
+                engine_id=i,
+                device=str(self.engines[i].device),
+                active=self._active[i],
+                inflight=self._inflight[i],
+                dispatches=self._dispatch_counts[i],
+                rows=self._rows[i],
+                slots=self._slots[i],
+                recompiles=self.engines[i].post_warmup_recompiles)
+                for i in range(len(self.engines))]
+
+
+class AutoscaleAdvisor:
+    """SLO gauges -> desired engine count, with hysteresis.
+
+    Reads the registry surface the serving stack already exports —
+    ``serve_decision_latency_p99_ms``, ``serve_queue_depth``,
+    ``serve_batch_occupancy``, ``serve_shed_total`` — and votes each
+    :meth:`observe` tick:
+
+    - **up** when the tail is blowing the target (p99 over
+      ``p99_target_ms``), the queue is backing up past ``queue_high``,
+      or ANY request was shed since the last tick (shedding is the
+      loudest under-capacity signal there is);
+    - **down** when capacity is clearly idle: occupancy under
+      ``occupancy_low`` with an empty queue, no shedding, and p99 under
+      half the target;
+    - **hold** otherwise.
+
+    A vote only moves the desired count after ``hysteresis`` CONSECUTIVE
+    same-direction votes (mixed or hold votes reset the streak), so a
+    steady load cannot flap the fleet — pinned by the hysteresis
+    property test. The desired count is exported as the
+    ``serve_autoscale_desired_engines`` gauge; resize decisions count in
+    ``serve_autoscale_resizes_total``.
+    """
+
+    def __init__(self, registry, n_max: int, n_min: int = 1,
+                 initial: "int | None" = None,
+                 p99_target_ms: float = 50.0, queue_high: int = 64,
+                 occupancy_low: float = 0.25, hysteresis: int = 3):
+        if n_min < 1 or n_max < n_min:
+            raise ValueError(f"need 1 <= n_min <= n_max, got "
+                             f"n_min={n_min}, n_max={n_max}")
+        if hysteresis < 1:
+            raise ValueError(f"hysteresis must be >= 1, got {hysteresis}")
+        self.registry = registry
+        self.n_min = int(n_min)
+        self.n_max = int(n_max)
+        self.p99_target_ms = float(p99_target_ms)
+        self.queue_high = int(queue_high)
+        self.occupancy_low = float(occupancy_low)
+        self.hysteresis = int(hysteresis)
+        self.desired = (int(initial) if initial is not None else n_max)
+        self.desired = max(self.n_min, min(self.desired, self.n_max))
+        self._streak = 0          # signed: +k = k up votes in a row
+        self._shed_seen = 0.0
+        self._g_desired = registry.gauge(
+            "serve_autoscale_desired_engines",
+            "engine count the autoscale advisor currently wants")
+        self._resizes = registry.counter(
+            "serve_autoscale_resizes_total",
+            "times the advisor changed its desired engine count")
+        self._g_desired.set(self.desired)
+
+    def _vote(self) -> int:
+        # reading via registry.gauge() re-registers and returns the
+        # shared series object — unset gauges read 0, which only ever
+        # suppresses a vote, never invents pressure
+        p99 = self.registry.gauge("serve_decision_latency_p99_ms").value
+        depth = self.registry.gauge("serve_queue_depth").value
+        occ = self.registry.gauge("serve_batch_occupancy").value
+        shed = self.registry.counter("serve_shed_total").value
+        shed_delta = shed - self._shed_seen
+        self._shed_seen = shed
+        if (shed_delta > 0 or depth > self.queue_high
+                or (p99 > 0 and p99 > self.p99_target_ms)):
+            return 1
+        if (depth == 0 and shed_delta == 0 and occ < self.occupancy_low
+                and p99 < self.p99_target_ms / 2):
+            return -1
+        return 0
+
+    def observe(self) -> int:
+        """One advisory tick: fold the current SLO surface into the
+        hysteresis streak; return the (possibly updated) desired engine
+        count."""
+        v = self._vote()
+        if v == 0:
+            self._streak = 0
+        elif v * self._streak >= 0:
+            self._streak += v
+        else:
+            self._streak = v
+        if abs(self._streak) >= self.hysteresis:
+            new = max(self.n_min, min(self.desired + v, self.n_max))
+            if new != self.desired:
+                self.desired = new
+                self._resizes.inc()
+                self._g_desired.set(new)
+            self._streak = 0
+        return self.desired

@@ -60,6 +60,51 @@ def pytest_configure(config):
         "them; the bit-level EQUIVALENCE contract of the fused update "
         "engine runs unmarked on every tier-1 pass "
         "(tests/test_algos.py::TestUpdateEngine)")
+    config.addinivalue_line(
+        "markers",
+        "timing_flake(retries=N): rerun the test up to N extra times "
+        "(fresh tmp_path each try) before reporting failure. Isolation "
+        "for KNOWN order/timing-dependent flakes only — each use must "
+        "carry a tracking note naming the observed failure signature; "
+        "a test that fails deterministically still fails after the "
+        "retries, so real regressions cannot hide behind the marker")
+
+
+def pytest_runtest_protocol(item, nextitem):
+    """Retry protocol for ``timing_flake``-marked tests (no
+    pytest-rerunfailures in the image — this is the dependency-free
+    subset we need). A failed try is re-run up to ``retries`` more
+    times; only the LAST try's reports are posted, plus a visible
+    warning that a retry happened so the flake stays observable in
+    ``-W error``-less runs rather than silently absorbed."""
+    marker = item.get_closest_marker("timing_flake")
+    if marker is None:
+        return None
+    retries = int(marker.kwargs.get("retries", 2))
+    from _pytest.runner import runtestprotocol
+    for attempt in range(retries + 1):
+        item.ihook.pytest_runtest_logstart(nodeid=item.nodeid,
+                                           location=item.location)
+        reports = runtestprotocol(item, nextitem=nextitem, log=False)
+        failed = [r for r in reports if r.failed]
+        if not failed or attempt == retries:
+            if failed and attempt:
+                pass        # exhausted: last try's failure is reported
+            elif attempt:
+                item.warn(pytest.PytestWarning(
+                    f"timing_flake: {item.nodeid} passed on retry "
+                    f"{attempt}/{retries} (tracking note on the test "
+                    f"names the signature)"))
+            for r in reports:
+                item.ihook.pytest_runtest_logreport(report=r)
+            item.ihook.pytest_runtest_logfinish(nodeid=item.nodeid,
+                                                location=item.location)
+            return True
+        item.ihook.pytest_runtest_logfinish(nodeid=item.nodeid,
+                                            location=item.location)
+        # a retry must not reuse the failed try's tmp_path/fixtures:
+        # teardown ran inside runtestprotocol, setup reruns next loop
+    return True
 
 
 def pytest_collection_modifyitems(config, items):
