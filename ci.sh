@@ -206,6 +206,69 @@ print("chaos smoke ok:", {r: round(rows["policy"]["degradation"], 3)
                           for r, rows in rep["regimes"].items()})
 EOF
 
+echo "=== smoke: generalization matrix (train --domains -> 2x2 cross table, CPU) ==="
+# ISSUE 14 acceptance: a tiny train --domains run plus a clean twin feed
+# evaluate --matrix, which must produce the train-regime x eval-regime
+# cross table (mixed + clean + SJF rows, none + overload columns) with
+# no jobs lost against the DRAWN capacities, degradation in every cell,
+# and — under --alarms — zero post-warmup recompiles (one compiled step
+# serves the whole domain distribution; strict-alarms is the gate).
+MATRIX_OBS_DIR=$(mktemp -d /tmp/ci_matrix_obs.XXXXXX)
+MATRIX_CKPT_DIR=$(mktemp -d /tmp/ci_matrix_ckpt.XXXXXX)
+MATRIX_CLEAN_DIR=$(mktemp -d /tmp/ci_matrix_clean.XXXXXX)
+MATRIX_JSON=$(mktemp /tmp/ci_matrix.XXXXXX.json)
+trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$VTRACE_OBS_DIR" \
+    "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON" \
+    "$SOAK_JSON" "$TRACE_JSON" \
+    "$MATRIX_OBS_DIR" "$MATRIX_CKPT_DIR" "$MATRIX_CLEAN_DIR" \
+    "$MATRIX_JSON"' EXIT
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.train --config ppo-mlp-synth64 \
+    --domains mixed \
+    --iterations 2 --n-envs 2 --n-nodes 2 --gpus-per-node 4 \
+    --window-jobs 16 --horizon 64 --queue-len 4 --n-steps 8 \
+    --n-epochs 1 --n-minibatches 2 --log-every 1 \
+    --ckpt-dir "$MATRIX_CKPT_DIR" --ckpt-every 1 > /dev/null
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.train --config ppo-mlp-synth64 \
+    --iterations 2 --n-envs 2 --n-nodes 2 --gpus-per-node 4 \
+    --window-jobs 16 --horizon 64 --queue-len 4 --n-steps 8 \
+    --n-epochs 1 --n-minibatches 2 --log-every 1 \
+    --ckpt-dir "$MATRIX_CLEAN_DIR" --ckpt-every 1 > /dev/null
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.evaluate --config ppo-mlp-synth64 \
+    --domains mixed --ckpt-dir "$MATRIX_CKPT_DIR" \
+    --matrix --matrix-regimes overload --matrix-baselines sjf \
+    --matrix-ckpt clean="$MATRIX_CLEAN_DIR" \
+    --n-envs 2 --n-nodes 2 --gpus-per-node 4 --window-jobs 16 \
+    --queue-len 4 --horizon 256 --max-steps 256 \
+    --obs-dir "$MATRIX_OBS_DIR" --alarms > "$MATRIX_JSON"
+timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.obs.report "$MATRIX_OBS_DIR" \
+    --strict-alarms > /dev/null
+python - "$MATRIX_JSON" "$MATRIX_OBS_DIR" <<'EOF'
+import json, sys
+rep = json.load(open(sys.argv[1]))
+assert rep["jobs_lost"] == 0, f"jobs lost under domains: {rep['jobs_lost']}"
+assert set(rep["cells"]) == {"none", "overload"}, rep["cells"].keys()
+for regime, rows in rep["cells"].items():
+    assert set(rows) == {"mixed", "clean", "sjf"}, (regime, rows.keys())
+    for sched, row in rows.items():
+        assert row["degradation"] is not None, (regime, sched)
+assert rep["domain_stats"]["overload"]["mean_load"] > 1.5
+assert rep["repro"]["matrix_seed"] == 0
+assert rep["repro"]["matrix_ckpts"], rep["repro"]
+from rlgpuschedule_tpu.obs import read_events
+events = read_events(sys.argv[2] + "/events.matrix.jsonl")
+cells = [e for e in events if e["kind"] == "domain_cell"]
+assert len(cells) == 6, f"expected 2 regimes x 3 rows, got {len(cells)}"
+prom = open(sys.argv[2] + "/metrics.prom").read()
+assert "matrix_overload_mixed_degradation" in prom
+print("matrix smoke ok:", {f"{r}/{s}": round(row["degradation"], 3)
+                           for r, rows in rep["cells"].items()
+                           for s, row in rows.items()})
+EOF
+
 echo "=== smoke: serving (bench + fleet replay, CPU) ==="
 # ISSUE 7 acceptance: a short serve --bench must report p50/p99 decision
 # latency and nonzero decisions/s with ZERO post-warmup recompiles
@@ -323,6 +386,8 @@ PBT_JSON=$(mktemp /tmp/ci_pbt.XXXXXX.json)
 trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$VTRACE_OBS_DIR" \
     "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON" \
     "$SOAK_JSON" "$TRACE_JSON" \
+    "$MATRIX_OBS_DIR" "$MATRIX_CKPT_DIR" "$MATRIX_CLEAN_DIR" \
+    "$MATRIX_JSON" \
     "$MESH_OBS_DIR" "$PBT_OBS_DIR" "$MESH_JSON" "$PBT_JSON"' EXIT
 # JAX_ENABLE_COMPILATION_CACHE=false on BOTH mesh trains: the persistent
 # compile cache flakily heap-corrupts (malloc_consolidate / segfault,

@@ -694,12 +694,23 @@ class AsyncRunner:
             carry, exp.carry)
 
 
-def _make_pop_rollout(apply_fn, env_params, n_steps):
+def _make_pop_rollout(apply_fn, env_params, n_steps,
+                      with_faults: bool = False):
     """The actor half of the population step: vmap the SAME rollout the
     fused ``make_population_step`` vmaps — member params/carries mapped,
     traces broadcast (``in_axes=None``, one shared env-window set for
-    fitness comparability)."""
+    fitness comparability). Per-member [P, E] fault-schedule stacks map
+    over the member axis like the carries (``with_faults``)."""
     from .algos.rollout import rollout as rollout_fn
+
+    if with_faults:
+        def pop_rollout_faulty(params, carries, traces, faults):
+            return jax.vmap(
+                lambda p, c, t, f: rollout_fn(apply_fn, p, env_params, t,
+                                              c, n_steps, f),
+                in_axes=(0, 0, None, 0))(params, carries, traces, faults)
+
+        return pop_rollout_faulty
 
     def pop_rollout(params, carries, traces):
         return jax.vmap(
@@ -798,6 +809,10 @@ class AsyncPopulationRunner:
         pexp.states = put_global(pexp.states, self._lrep)
         pexp.keys = jax.device_put(pexp.keys, self._lrep)
         pexp.hparams = put_global(pexp.hparams, self._lrep)
+        if pexp.faults is not None:
+            # the [P, E] member schedule stacks are actor-side data, like
+            # the traces
+            pexp.faults = put_global(pexp.faults, self._arep)
 
         apply_fn = pexp.apply_fn
         pop_learn = jax.vmap(make_member_learn_step(apply_fn, cfg.ppo),
@@ -808,12 +823,14 @@ class AsyncPopulationRunner:
         learn_donate = () if on_cpu else (0,)     # the member-state stack
         params_a = jax.device_put(pexp.states.params, self._arep)
         rollout_jit = jax.jit(
-            _make_pop_rollout(apply_fn, pexp.env_params, cfg.ppo.n_steps),
+            _make_pop_rollout(apply_fn, pexp.env_params, cfg.ppo.n_steps,
+                              with_faults=pexp.faults is not None),
             donate_argnums=rollout_donate)
-        self._rollout = rollout_jit.lower(
-            params_a, pexp.carries, pexp.traces).compile()
-        _, tr_s, lv_s = jax.eval_shape(rollout_jit, params_a, pexp.carries,
-                                       pexp.traces)
+        rollout_args = (params_a, pexp.carries, pexp.traces)
+        if pexp.faults is not None:
+            rollout_args = rollout_args + (pexp.faults,)
+        self._rollout = rollout_jit.lower(*rollout_args).compile()
+        _, tr_s, lv_s = jax.eval_shape(rollout_jit, *rollout_args)
         tr0 = jax.device_put(jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype), tr_s), self._lrep)
         lv0 = jax.device_put(jax.tree.map(
@@ -896,11 +913,13 @@ class AsyncPopulationRunner:
                         i - self.staleness_bound)
                 self._actor_idle_s += gated
                 carries = pexp.carries
+                roll_args = (params, carries, pexp.traces)
+                if pexp.faults is not None:
+                    roll_args = roll_args + (pexp.faults,)
                 with tracer.span("actor", iteration=i), \
                         self.overlap.span("actor"), sections("actor"), \
                         no_implicit_transfers(), self._dispatch_lock:
-                    carries, tr, last_value = self._rollout(
-                        params, carries, pexp.traces)
+                    carries, tr, last_value = self._rollout(*roll_args)
                     batch = (jax.device_put(tr, self._lrep),
                              jax.device_put(last_value, self._lrep))
                     jax.block_until_ready(batch)

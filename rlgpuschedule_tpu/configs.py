@@ -86,6 +86,12 @@ class ExperimentConfig:
     # expose per-node health in the observation so the policy can LEARN
     # to route around drains. None = permanently healthy cluster.
     faults: str | None = None
+    # domain randomization (domains.schedule): train across a named
+    # scenario DISTRIBUTION (DOMAIN_REGIMES) — per-env cluster geometry,
+    # hardware speed, and arrival-process draws threaded through the
+    # rollout as data next to the traces, composing with cfg.faults.
+    # None = the single fixed cluster, bit-identical.
+    domains: str | None = None
 
     @property
     def total_gpus(self) -> int:
@@ -156,6 +162,7 @@ MODE_FLAGS: dict[str, str] = {
     "async": "--async",
     "pbt": "--pbt",
     "faults": "--faults",
+    "domains": "--domains",
     "fault_injection": "--fault",
     "fused_chunk": "--fused-chunk",
     "rollbacks": "--max-rollbacks",
@@ -195,8 +202,17 @@ MODE_REFUSALS: tuple[tuple[str, str, str], ...] = (
     ("async", "mesh",
      "the async engine resolves its own actor/learner submeshes from "
      "the unified mesh"),
-    ("pbt", "faults",
-     "the population step does not thread fault schedules"),
+    # pbt x faults was refused here until ISSUE 14: the population step
+    # now threads per-member [P, E] fault schedules (seeded (seed,
+    # member, env)) through the vmapped member rollout
+    ("pbt", "domains",
+     "per-member domain draws would need member-indexed trace windows "
+     "through the population stack; sample domain diversity across "
+     "single-run seeds instead"),
+    ("hier", "domains",
+     "domain schedules carry per-node capacity through the flat sim "
+     "path only; the pod-sharded hierarchical env has no geometry "
+     "threading yet"),
     ("pbt", "fused_chunk",
      "the PBT loop interleaves host-side exploit/explore between steps"),
     ("pbt", "mesh",
@@ -273,4 +289,5 @@ def repro_tuple(cfg: ExperimentConfig, ckpt_dir: str | None = None,
             "window_jobs": cfg.window_jobs, "queue_len": cfg.queue_len,
             "horizon": cfg.horizon, "obs_kind": cfg.obs_kind,
             "drain_frac": cfg.drain_frac, "faults": cfg.faults,
+            "domains": cfg.domains,
             "ckpt_dir": ckpt_dir, "ckpt_step": ckpt_step}

@@ -1,0 +1,18 @@
+"""L6 domain-randomization layer: scenario distributions as data.
+
+One compiled step serves the whole domain distribution — cluster
+geometry, hardware speed, arrival process, and job mix are all seeded
+per-env data (``DomainSchedule`` rides the existing ``faults`` slot;
+trace windows come from ``traces.fit``). See README "Domain
+randomization"."""
+from .schedule import (DOMAIN_REGIMES, DomainDraw, DomainSchedule,
+                       DomainSpec, domain_schedule, domain_stats,
+                       resolve_domain, sample_domain, sample_env_domains,
+                       stack_domain_schedules, validate_domain_schedule)
+
+__all__ = [
+    "DOMAIN_REGIMES", "DomainDraw", "DomainSchedule", "DomainSpec",
+    "domain_schedule", "domain_stats", "resolve_domain", "sample_domain",
+    "sample_env_domains", "stack_domain_schedules",
+    "validate_domain_schedule",
+]

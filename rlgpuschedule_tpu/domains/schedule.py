@@ -1,0 +1,295 @@
+"""Domain-randomization engine (L6) — the whole scenario space as data.
+
+``sim.faults`` proved the recipe for ONE axis of variation: schedules are
+trace-like pytree DATA, so a single compiled step serves an entire fault
+distribution (the Jumanji scalable-env pattern). This module extends the
+same contract to every axis a production cluster varies on:
+
+- **geometry** — per-node GPU capacity (shrunken nodes, nodes absent
+  outright) carried by a new ``capacity`` array;
+- **hardware speed** — heterogeneous GPU generations as per-node speed
+  factors riding the EXISTING straggler ``slowdown`` array (a V100 next
+  to an H100 is a permanent 2-4x straggler, so the sim/oracle stretch
+  machinery applies unchanged);
+- **arrival process + job mix** — offered load, diurnal cycles,
+  flash-crowd bursts, and duration scaling, realized as seeded trace
+  windows by ``traces.fit.gen_domain_window`` (distributions fit from
+  the Philly/PAI loaders).
+
+The carrier is :class:`DomainSchedule`: a strict superset of
+:class:`~..sim.faults.FaultSchedule` (same three fault fields + per-node
+``capacity``). Every fault consumer (``node_up``, ``job_stretch``,
+``effective_free``, ``core.rl_step``, the oracle) reads fields by name,
+so a DomainSchedule flows through the existing ``faults`` argument of
+the env/rollout/experiment stack with ZERO new threading — and because
+the domains path always passes a DomainSchedule (even for the identity
+draw), all domain regimes share one pytree structure and therefore ONE
+compiled step (CompileCounter-gated in tests/test_domains.py).
+
+Host-side, :data:`DOMAIN_REGIMES` names the scenario distributions
+(clean control, geometry shrink, hardware heterogeneity, sustained
+overload, flash crowds, everything-at-once) and :func:`sample_domain`
+draws seeded per-env :class:`DomainDraw`s from them — ``train
+--domains`` and the ``evaluate --matrix`` generalization cross-table
+both consume exactly these draws, so a matrix cell is reproducible from
+``(seed, regime, n_nodes, gpus_per_node)`` alone.
+"""
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import NamedTuple, Sequence
+
+import jax
+import numpy as np
+
+from ..sim.faults import (FaultSchedule, no_faults, stack_fault_schedules,
+                          validate_fault_schedule)
+
+
+class DomainSchedule(NamedTuple):
+    """Per-env domain data (fixed shapes): the :class:`FaultSchedule`
+    triple plus per-node GPU capacity. Field ORDER keeps the fault prefix
+    so duck-typed fault consumers are oblivious; a 4-leaf pytree is a
+    different treedef from the 3-leaf FaultSchedule, which is exactly
+    what keeps the clean-faults program and the domains program from
+    silently sharing (and invalidating) each other's caches."""
+    down_start: jax.Array  # f32[N, W] drain instants (+inf = unused slot)
+    down_end: jax.Array    # f32[N, W] return instants (+inf = never)
+    slowdown: jax.Array    # f32[N]    speed factor (faults x hardware)
+    capacity: jax.Array    # i32[N]    usable GPUs per node (0 = absent)
+
+    @property
+    def n_nodes(self) -> int:
+        return int(self.down_start.shape[-2])
+
+
+# ---- named domain regimes ---------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DomainSpec:
+    """A named scenario DISTRIBUTION (static + hashable — it lives inside
+    ``EnvParams.domain_process``); :func:`sample_domain` draws concrete
+    seeded :class:`DomainDraw` data from it. Geometry/speed knobs shape
+    the cluster; load/burst/diurnal/duration knobs shape the arrival
+    process realized by ``traces.fit.gen_domain_window``."""
+    name: str
+    # geometry: per-node capacity ~ round(U[capacity_min_frac, 1] * G),
+    # then each node absent outright with p_node_off (capacity 0)
+    capacity_min_frac: float = 1.0
+    p_node_off: float = 0.0
+    # hardware heterogeneity: per-node chance of a permanent speed factor
+    # in [slowdown_min, slowdown_max] (rides the straggler machinery)
+    p_hetero: float = 0.0
+    slowdown_min: float = 1.5
+    slowdown_max: float = 4.0
+    # arrival process: offered load ~ U[load_min, load_max]; diurnal
+    # modulation; a flash crowd collapsing this fraction of the window's
+    # jobs onto one burst instant
+    load_min: float = 1.1
+    load_max: float = 1.1
+    diurnal: bool = False
+    burst_frac: float = 0.0
+    # job mix: duration median multiplier ~ U[min, max]
+    duration_scale_min: float = 1.0
+    duration_scale_max: float = 1.0
+
+    def __post_init__(self):
+        if not 0.0 < self.capacity_min_frac <= 1.0:
+            raise ValueError(
+                f"capacity_min_frac must be in (0, 1], got "
+                f"{self.capacity_min_frac}")
+        for p_name in ("p_node_off", "p_hetero", "burst_frac"):
+            p = getattr(self, p_name)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{p_name} must be in [0, 1], got {p}")
+        if self.p_node_off >= 1.0 and self.name != "_impossible":
+            raise ValueError("p_node_off=1 would draw empty clusters")
+        if not 1.0 <= self.slowdown_min <= self.slowdown_max:
+            raise ValueError(
+                f"want 1 <= slowdown_min <= slowdown_max, got "
+                f"[{self.slowdown_min}, {self.slowdown_max}]")
+        if not 0.0 < self.load_min <= self.load_max:
+            raise ValueError(f"want 0 < load_min <= load_max, got "
+                             f"[{self.load_min}, {self.load_max}]")
+        if not 0.0 < self.duration_scale_min <= self.duration_scale_max:
+            raise ValueError(
+                f"want 0 < duration_scale_min <= duration_scale_max, got "
+                f"[{self.duration_scale_min}, {self.duration_scale_max}]")
+
+
+# The generalization matrix's canonical regimes: a clean control (the
+# degradation denominator — load pinned at the configs' default 1.1),
+# the broad training distribution, and one regime per axis so a matrix
+# row localizes WHICH kind of shift breaks a policy. "overload" pins the
+# BASELINE.md weakness (policy trails oracle SJF/Tiresias by ~2.3% at
+# 1.6x sustained overload) as a tracked column.
+DOMAIN_REGIMES: dict[str, DomainSpec] = {
+    "none": DomainSpec("none"),
+    "baseline": DomainSpec("baseline", load_min=0.8, load_max=1.2,
+                           duration_scale_min=0.75,
+                           duration_scale_max=1.5),
+    "geom": DomainSpec("geom", capacity_min_frac=0.5, p_node_off=0.1,
+                       load_min=0.9, load_max=1.1),
+    "hetero": DomainSpec("hetero", p_hetero=0.4, load_min=0.9,
+                         load_max=1.1),
+    "overload": DomainSpec("overload", load_min=1.6, load_max=1.6),
+    "flash": DomainSpec("flash", burst_frac=0.5, load_min=1.0,
+                        load_max=1.2),
+    "mixed": DomainSpec("mixed", capacity_min_frac=0.5, p_node_off=0.1,
+                        p_hetero=0.4, load_min=0.8, load_max=1.4,
+                        diurnal=True, burst_frac=0.25,
+                        duration_scale_min=0.75, duration_scale_max=1.5),
+}
+
+
+def resolve_domain(spec: "DomainSpec | str") -> DomainSpec:
+    if isinstance(spec, DomainSpec):
+        return spec
+    if spec not in DOMAIN_REGIMES:
+        raise ValueError(f"unknown domain regime {spec!r}; known: "
+                         f"{sorted(DOMAIN_REGIMES)}")
+    return DOMAIN_REGIMES[spec]
+
+
+# ---- seeded draws -----------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DomainDraw:
+    """One concrete host-side draw from a :class:`DomainSpec`: the
+    cluster half (capacity/slowdown, packed into a :class:`DomainSchedule`
+    by :func:`domain_schedule`) plus the arrival half (load/burst/...,
+    consumed by ``experiment.make_domain_windows`` when it generates this
+    env's trace windows)."""
+    spec_name: str
+    capacity: np.ndarray    # i32[N] usable GPUs per node
+    slowdown: np.ndarray    # f32[N] hardware speed factor (>= 1)
+    load: float
+    duration_scale: float
+    burst_frac: float
+    diurnal: bool
+
+    @property
+    def total_gpus(self) -> int:
+        return int(self.capacity.sum())
+
+
+def sample_domain(spec: "DomainSpec | str", n_nodes: int,
+                  gpus_per_node: int, seed) -> DomainDraw:
+    """One seeded host-side draw. ``seed`` may be an int or a tuple of
+    ints (e.g. ``(base_seed, env)``); the spec name is folded in, so one
+    base seed yields independent draws per regime — the matrix's repro
+    tuple is exactly ``(seed, regime, n_nodes, gpus_per_node)``."""
+    spec = resolve_domain(spec)
+    if n_nodes <= 0 or gpus_per_node <= 0:
+        raise ValueError(f"want positive n_nodes/gpus_per_node, got "
+                         f"{n_nodes}/{gpus_per_node}")
+    entropy = list(seed) if isinstance(seed, (tuple, list)) else [int(seed)]
+    rng = np.random.default_rng(
+        [zlib.crc32(("domain:" + spec.name).encode()),
+         *[int(s) & 0xFFFFFFFF for s in entropy]])
+    frac = rng.uniform(spec.capacity_min_frac, 1.0, size=n_nodes)
+    cap = np.maximum(np.rint(frac * gpus_per_node), 1).astype(np.int32)
+    cap = np.where(rng.random(n_nodes) < spec.p_node_off, 0, cap)
+    if cap.sum() == 0:
+        # a zero-GPU cluster can schedule nothing; keep the draw valid by
+        # forcing one full node (p_node_off < 1 makes this vanishingly
+        # rare at realistic n_nodes, but tiny test clusters hit it)
+        cap[0] = gpus_per_node
+    hetero = rng.random(n_nodes) < spec.p_hetero
+    slow = np.where(hetero, rng.uniform(spec.slowdown_min,
+                                        spec.slowdown_max, size=n_nodes),
+                    1.0).astype(np.float32)
+    return DomainDraw(
+        spec_name=spec.name, capacity=cap, slowdown=slow,
+        load=float(rng.uniform(spec.load_min, spec.load_max)),
+        duration_scale=float(rng.uniform(spec.duration_scale_min,
+                                         spec.duration_scale_max)),
+        burst_frac=spec.burst_frac, diurnal=spec.diurnal)
+
+
+def sample_env_domains(spec: "DomainSpec | str", n_nodes: int,
+                       gpus_per_node: int, seed: int, n_envs: int,
+                       ) -> list[DomainDraw]:
+    """Per-env draws for the vec-env batch: env ``e`` draws from
+    ``(seed, e)``, so the batch covers the regime's distribution rather
+    than replaying one cluster E times."""
+    return [sample_domain(spec, n_nodes, gpus_per_node, (seed, e))
+            for e in range(n_envs)]
+
+
+# ---- schedules --------------------------------------------------------------
+
+def domain_schedule(draw: DomainDraw,
+                    faults: FaultSchedule | None = None) -> DomainSchedule:
+    """Pack a draw's cluster half into the :class:`DomainSchedule` the
+    jitted step consumes, composing with an optional per-env
+    :class:`FaultSchedule` (``--domains`` and ``--faults`` stack): drain
+    windows come from the fault draw, and the speed factor is the
+    elementwise MAX of hardware heterogeneity and transient straggling —
+    a slow GPU that also straggles runs at its worst factor, not the
+    product (both model the same remaining-work stretch)."""
+    n = len(draw.capacity)
+    base = no_faults(n) if faults is None else faults
+    if getattr(base, "n_nodes", n) != n:
+        raise ValueError(f"fault schedule is shaped for {base.n_nodes} "
+                         f"node(s); the domain draw has {n}")
+    slow = np.maximum(np.asarray(base.slowdown, np.float32),
+                      draw.slowdown).astype(np.float32)
+    return DomainSchedule(
+        down_start=np.asarray(base.down_start, np.float32),
+        down_end=np.asarray(base.down_end, np.float32),
+        slowdown=slow,
+        capacity=np.asarray(draw.capacity, np.int32))
+
+
+def validate_domain_schedule(n_nodes: int, gpus_per_node: int,
+                             schedule: DomainSchedule) -> DomainSchedule:
+    """Host-side fail-fast guard mirroring ``validate_fault_schedule``
+    (which checks the fault triple) plus the capacity contract: shape
+    [N], integral, within [0, gpus_per_node], and a non-empty cluster.
+    Returns host numpy arrays."""
+    fs = validate_fault_schedule(n_nodes, schedule)
+    cap = np.asarray(schedule.capacity)
+    if cap.shape != (n_nodes,):
+        raise ValueError(f"domain capacity must have shape ({n_nodes},); "
+                         f"got {cap.shape}")
+    if not np.issubdtype(cap.dtype, np.integer):
+        raise ValueError(f"domain capacity must be integral GPUs, got "
+                         f"dtype {cap.dtype}")
+    if (cap < 0).any() or (cap > gpus_per_node).any():
+        raise ValueError(
+            f"per-node capacity must lie in [0, {gpus_per_node}] (the "
+            f"static gpus_per_node bound the obs/action layout is built "
+            f"for); got [{int(cap.min())}, {int(cap.max())}]")
+    if cap.sum() <= 0:
+        raise ValueError("domain capacity sums to zero GPUs — an empty "
+                         "cluster can schedule nothing")
+    return DomainSchedule(fs.down_start, fs.down_end, fs.slowdown,
+                          cap.astype(np.int32))
+
+
+def stack_domain_schedules(schedules: Sequence[DomainSchedule],
+                           ) -> DomainSchedule:
+    """Stack per-env schedules into a batched device DomainSchedule
+    (leading axis E) — same generic tree-stack as the fault twin."""
+    return stack_fault_schedules(schedules)
+
+
+def domain_stats(draw: DomainDraw) -> dict:
+    """Host summary of one draw — what the matrix's ``domain_cell``
+    events carry so ``obs.report`` can tell the story without re-deriving
+    it from arrays."""
+    cap = np.asarray(draw.capacity, np.int64)
+    slow = np.asarray(draw.slowdown, np.float64)
+    return {
+        "spec": draw.spec_name,
+        "total_gpus": int(cap.sum()),
+        "n_nodes_off": int((cap == 0).sum()),
+        "n_hetero": int((slow > 1.0).sum()),
+        "max_slowdown": float(slow.max()) if slow.size else 1.0,
+        "load": float(draw.load),
+        "duration_scale": float(draw.duration_scale),
+        "burst_frac": float(draw.burst_frac),
+        "diurnal": bool(draw.diurnal),
+    }

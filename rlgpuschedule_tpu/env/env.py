@@ -49,6 +49,15 @@ class EnvParams:
     # around drains. Flat observations only (the grid/graph encoders pin
     # their channel/feature counts); checked in __post_init__.
     fault_obs: bool = False
+    # domain randomization (domains.schedule): the static DISTRIBUTION
+    # cluster geometry / hardware speed / arrival knobs are drawn from
+    # (the sampled DomainSchedule is per-env data riding the faults
+    # slot). None = the fixed-cluster program, bit-identical.
+    domain_process: Any = None
+    # append a per-node geometry channel (capacity / gpus_per_node) so
+    # the policy can tell a shrunken node from a busy one. Flat only,
+    # like fault_obs; checked in __post_init__.
+    domain_obs: bool = False
 
     def __post_init__(self):
         if self.fault_obs and self.obs_kind != "flat":
@@ -57,6 +66,11 @@ class EnvParams:
                 f"observation; obs_kind={self.obs_kind!r} pins its "
                 f"feature layout (train grid/graph fault policies "
                 f"without health visibility, or use flat)")
+        if self.domain_obs and self.obs_kind != "flat":
+            raise ValueError(
+                f"domain_obs appends per-node geometry to the FLAT "
+                f"observation; obs_kind={self.obs_kind!r} pins its "
+                f"feature layout")
 
     @property
     def n_actions(self) -> int:
@@ -66,7 +80,8 @@ class EnvParams:
         s, k, r = self.sim, self.sim.queue_len, self.sim.preempt_len
         if self.obs_kind == "flat":
             n_health = s.n_nodes if self.fault_obs else 0
-            return (s.n_nodes + 4 * k + 4 * r + 2 + n_health,)
+            n_geom = s.n_nodes if self.domain_obs else 0
+            return (s.n_nodes + 4 * k + 4 * r + 2 + n_health + n_geom,)
         if self.obs_kind == "grid":
             return (s.n_nodes + k + r, s.gpus_per_node, 2)
         return (s.n_nodes + k + r, obs_lib.GRAPH_FEATURES)
@@ -99,6 +114,11 @@ def build_obs(params: EnvParams, sim: SimState, trace: Trace,
         # every node healthy at full speed
         obs = jnp.concatenate(
             [obs, obs_lib.node_health(params.sim, sim, faults)])
+    if params.domain_obs:
+        # geometry after health, same append-only contract: the prefix
+        # stays laid out identically to the fixed-cluster observation
+        obs = jnp.concatenate(
+            [obs, obs_lib.node_geometry(params.sim, faults)])
     return obs
 
 
@@ -118,7 +138,10 @@ def _observe(params: EnvParams, sim: SimState, trace: Trace,
 
 def reset(params: EnvParams, trace: Trace,
           faults: FaultSchedule | None = None) -> tuple[EnvState, TimeStep]:
-    sim = core.init_state(params.sim, trace)
+    # the schedule seeds init_state too: a DomainSchedule's per-node
+    # capacity IS the initial free vector (plain FaultSchedule/None keep
+    # the static full cluster, bit-identical)
+    sim = core.init_state(params.sim, trace, faults)
     state = EnvState(sim=sim, t=jnp.int32(0))
     obs, mask = _observe(params, sim, trace, faults)
     ts = TimeStep(

@@ -29,6 +29,19 @@ def node_health(params: SimParams, state: SimState,
     return jnp.where(up, 1.0 / faults.slowdown, 0.0).astype(jnp.float32)
 
 
+def node_geometry(params: SimParams, faults=None) -> jax.Array:
+    """Per-node capacity feature [N]: usable GPUs / gpus_per_node — the
+    geometry channel a domain-randomized policy needs to tell a shrunken
+    (or absent) node from a merely busy one. Reads the ``capacity``
+    carried by a ``domains.DomainSchedule`` in the faults slot; a plain
+    FaultSchedule or ``faults=None`` (clean replay) reads as a full
+    homogeneous cluster."""
+    cap = getattr(faults, "capacity", None)
+    if cap is None:
+        return jnp.ones((params.n_nodes,), jnp.float32)
+    return jnp.asarray(cap, jnp.float32) / params.gpus_per_node
+
+
 def queue_features(params: SimParams, state: SimState, trace: Trace,
                    queue: jax.Array | None = None) -> jax.Array:
     """Per-queue-slot features [K, 4]: demand/capacity, waiting time,

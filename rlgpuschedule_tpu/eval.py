@@ -12,7 +12,9 @@ baseline side replays the same windows through the oracle event-driven sim
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import functools
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -245,6 +247,23 @@ def replay(apply_fn: Callable, net_params: Any,
     return result
 
 
+def _shift_schedule(fs, base: float):
+    """Rebase a GLOBAL-time fault/domain schedule onto a stitched window's
+    LOCAL clock (window time 0 = global ``base``): down windows fully in
+    the past collapse to never-active (+inf/+inf), a drain straddling
+    ``base`` becomes active from local 0, future windows shift left.
+    Slowdown and capacity are time-invariant and pass through — the
+    returned value keeps the input's type (``_replace``), so a
+    DomainSchedule stays a DomainSchedule."""
+    start = np.asarray(fs.down_start, np.float64) - base
+    end = np.asarray(fs.down_end, np.float64) - base
+    past = end <= 0.0
+    start = np.where(past, np.inf, np.maximum(start, 0.0))
+    end = np.where(past, np.inf, end)
+    return fs._replace(down_start=start.astype(np.float32),
+                       down_end=end.astype(np.float32))
+
+
 def full_trace_replay(apply_fn: Callable, net_params: Any,
                       env_params: EnvParams, source: ArrayTrace,
                       max_steps_per_window: int | None = None,
@@ -252,11 +271,22 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
                       key: jax.Array | None = None,
                       backlog_gate: int = 0,
                       stall_guard: bool = True,
-                      drain_completions: int = 1) -> dict[str, Any]:
+                      drain_completions: int = 1,
+                      faults=None) -> dict[str, Any]:
     """Policy avg-JCT over an ENTIRE source trace via sequential windowed
     replay with residual carry (VERDICT r1 missing #4) — one number
     comparable to the ``native``/oracle baselines over the same trace
     (SURVEY.md §3.4, north-star #2).
+
+    ``faults``: ONE unbatched :class:`~.sim.faults.FaultSchedule` (or
+    :class:`~.domains.DomainSchedule` — randomized geometry/speed) in
+    GLOBAL trace time, spanning the whole stream. Each stitched window
+    replays under the schedule rebased onto its local clock
+    (:func:`_shift_schedule`): same shapes every window, so the one
+    compiled window program still serves the entire stitch. Baselines
+    comparing against this number must run under the SAME schedule in
+    global time (``run_baseline(faults=...)`` — the oracle keeps one
+    global clock, so no shifting there).
 
     The trace streams through a fixed-shape job table of ``max_jobs``
     rows: each window holds the carried residual jobs (anything not DONE
@@ -316,6 +346,10 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
         raise ValueError("drain_completions must be >= 1 (a deep-backlog "
                          "window must free at least one table row)")
     sim = env_params.sim
+    if faults is not None and faults.down_start.shape[-2] != sim.n_nodes:
+        raise ValueError(
+            f"schedule covers {faults.down_start.shape[-2]} nodes; the "
+            f"stitch cluster has {sim.n_nodes}")
     J = sim.max_jobs
     drain_block = min(int(drain_completions), max(J // 2, 1))
     S = int(max_steps_per_window or 4 * J + 16)
@@ -327,13 +361,16 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
 
     @jax.jit
     def _window(net_params, trace: core.Trace, cutoff, need_completion,
-                wkey):
+                wkey, schedule=None):
         """One window replay. ``cutoff``: local freeze time (+inf = run to
         completion). ``need_completion`` (deep-backlog mode): ignore the
         clock until one valid job completes, then freeze — the step that
         completes is KEPT (its clock is the window's true span), unlike
-        the future-cutoff mode where the overshooting step is discarded."""
-        state, ts = env_lib.reset(rp, trace)
+        the future-cutoff mode where the overshooting step is discarded.
+        ``schedule``: this window's LOCAL-time fault/domain schedule
+        (``_shift_schedule``); a traced arg, so every window reuses the
+        one compiled program."""
+        state, ts = env_lib.reset(rp, trace, schedule)
 
         def scan_step(carry, k):
             state, obs, mask, frozen, stall = carry
@@ -350,7 +387,8 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
             if backlog_gate:
                 action = _gate_to_fifo(rp, state.sim.status, mask,
                                        action, backlog_gate)
-            new_state, new_ts = env_lib.step(rp, state, trace, action)
+            new_state, new_ts = env_lib.step(rp, state, trace, action,
+                                             schedule)
             done_before = jnp.sum(
                 (state.sim.status == DONE_STATUS) & trace.valid)
             # future cutoff: discard any step past it. already-arrived
@@ -377,11 +415,13 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
         # next one overshot), only continuous service — advance it, or
         # running jobs lose (cutoff − clock) of work at EVERY window seam
         # (measured ~2× JCT over-count on an overloaded 2k-job trace)
-        t_end = jnp.minimum(cutoff, core.next_event_time(state.sim, trace))
+        t_end = jnp.minimum(cutoff, core.next_event_time(state.sim, trace,
+                                                         schedule))
         t_end = jnp.maximum(t_end, state.sim.clock)
         sim = core.advance_to(
             state.sim, trace,
-            jnp.where(jnp.isfinite(t_end), t_end, state.sim.clock))
+            jnp.where(jnp.isfinite(t_end), t_end, state.sim.clock),
+            schedule)
         return state._replace(sim=sim)
 
     valid = np.flatnonzero(np.asarray(source.valid))
@@ -392,9 +432,19 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
     total = len(valid)
     if total == 0:
         raise ValueError("source trace has no valid jobs")
-    if int(gpus.max()) > sim.capacity:
-        raise ValueError("source demands exceed cluster capacity; clamp "
-                         "first (sim.core.validate_trace(clamp=True))")
+    # on a randomized-geometry cluster the binding bound is the DRAWN
+    # capacity, not the static one — a gang wider than the shrunken
+    # cluster would pend forever and trip the no-progress guard below
+    cap = getattr(faults, "capacity", None)
+    total_gpus = int(np.asarray(cap).sum()) if cap is not None \
+        else sim.capacity
+    if int(gpus.max()) > total_gpus:
+        raise ValueError(
+            f"source demands up to {int(gpus.max())} GPUs but the "
+            f"{'drawn' if cap is not None else 'static'} cluster has "
+            f"{total_gpus}; clamp the trace first "
+            f"(sim.core.validate_trace(clamp=True)) or use a milder "
+            f"domain draw")
 
     finish_g = np.full(total, np.nan)       # global finish times
     # residuals: original index -> remaining service
@@ -442,8 +492,10 @@ def full_trace_replay(apply_fn: Callable, net_params: Any,
             w_submit, w_duration, w_gpus, w_tenant, w_valid))
 
         key, wkey = jax.random.split(key)
+        sched = _shift_schedule(faults, base) if faults is not None \
+            else None
         state = _window(net_params, trace, jnp.float32(cutoff),
-                        jnp.bool_(need_completion), wkey)
+                        jnp.bool_(need_completion), wkey, sched)
         s = core.np_state(state.sim)
         done_rows = w_valid & (s.status == DONE_STATUS)
         finish_g[rows_idx[done_rows[:n_rows]]] = \
@@ -610,6 +662,7 @@ def full_trace_report(exp, max_jobs: int | None = None,
                       backlog_gate: int = 0,
                       stall_guard: bool = True,
                       drain_completions: int = 1,
+                      faults=None,
                       ) -> dict[str, Any]:
     """The FULL-trace comparison table (``evaluate --full-trace``): policy
     avg-JCT via :func:`full_trace_replay` vs the baselines run by the
@@ -625,7 +678,15 @@ def full_trace_report(exp, max_jobs: int | None = None,
     the queue view, not the job-table size), so a checkpoint trained at
     one window size can replay through a DEEPER stitched window, widening
     the backlog the stitcher holds between seams; the cluster shape and
-    queue_len must still match the checkpoint."""
+    queue_len must still match the checkpoint.
+
+    ``faults``: one GLOBAL-time fault/domain schedule the whole table
+    runs under (``evaluate --full-trace --stitch-faults/--stitch-domain``)
+    — the policy rows stitch through it window-by-window
+    (:func:`full_trace_replay`), the baselines run the SAME unshifted
+    schedule on the oracle's global clock, so the comparison stays
+    apples-to-apples on the degraded cluster. Forces the Python-oracle
+    baseline backend (the native engine has no fault model)."""
     eval_params = env_params or exp.env_params
     if isinstance(exp.env_params, HierParams) or \
             isinstance(eval_params, HierParams):
@@ -653,10 +714,15 @@ def full_trace_report(exp, max_jobs: int | None = None,
                             max_steps_per_window=max_steps_per_window,
                             backlog_gate=backlog_gate,
                             stall_guard=stall_guard,
-                            drain_completions=drain_completions)
+                            drain_completions=drain_completions,
+                            faults=faults)
     report: dict[str, Any] = {"policy": out["avg_jct"],
                               "n_jobs": out["n_jobs"],
                               "policy_windows": out["windows"]}
+    if faults is not None:
+        # a degraded-cluster table must never be confused with a clean
+        # one (same distinguishability contract as backlog_gate below)
+        report["faulty_cluster"] = True
     if backlog_gate:
         report["backlog_gate"] = int(backlog_gate)
     if _preempt_slice(eval_params) is not None:
@@ -678,13 +744,14 @@ def full_trace_report(exp, max_jobs: int | None = None,
                                 eval_params, source,
                                 max_steps_per_window=max_steps_per_window,
                                 policy="random", key=jax.random.PRNGKey(1),
-                                drain_completions=drain_completions)
+                                drain_completions=drain_completions,
+                                faults=faults)
         report["random"] = rnd["avg_jct"]
         if percentiles is not None:
             pcts["random"] = _pct_row(rnd["jct"], percentiles)
     for name in baselines:
         sim = run_baseline(source, exp.cfg.n_nodes, exp.cfg.gpus_per_node,
-                           name)
+                           name, faults=faults)
         report[name] = sim.avg_jct()
         if percentiles is not None:
             pcts[name] = _pct_row(sim.jcts(), percentiles)
@@ -702,18 +769,26 @@ def full_trace_report(exp, max_jobs: int | None = None,
 CHAOS_REGIMES = ("none", "sporadic", "storm", "straggler")
 
 
-def _chaos_conservation(states, traces, env_params: EnvParams) -> dict:
+def _chaos_conservation(states, traces, env_params: EnvParams,
+                        faults=None) -> dict:
     """The no-jobs-lost contract over a batch of final replay states:
     every node's ``free + allocated == capacity``, every RUNNING job holds
     exactly its gang, every non-RUNNING job holds nothing, and every valid
     job is in a legitimate lifecycle status — i.e. a drain KILLED jobs
     back to the queue rather than leaking them or their GPUs. Returns
-    ``{"jobs_lost": int, "conserved": bool}``; the chaos matrix asserts
-    both."""
+    ``{"jobs_lost": int, "conserved": bool}``; the chaos and
+    generalization matrices assert both.
+
+    ``faults``: the batched schedule the replay ran under. A
+    :class:`~.domains.DomainSchedule` carries per-node capacity [E, N] —
+    the conservation target on a randomized-geometry cluster is the
+    DRAWN capacity, not the static ``gpus_per_node``."""
     sim = jax.tree.map(np.asarray, states.sim)
     tr = jax.tree.map(np.asarray, traces)
-    g = env_params.sim.gpus_per_node
-    node_ok = bool((sim.alloc.sum(axis=1) + sim.free == g).all())
+    cap = getattr(faults, "capacity", None)
+    expected = (env_params.sim.gpus_per_node if cap is None
+                else np.asarray(cap))          # scalar or [E, N]
+    node_ok = bool((sim.alloc.sum(axis=1) + sim.free == expected).all())
     alloc_j = sim.alloc.sum(axis=2)                       # [E, J]
     running = sim.status == RUNNING_STATUS
     run_ok = bool((alloc_j[running] == tr.gpus[running]).all())
@@ -863,6 +938,227 @@ def format_chaos(report: dict[str, Any]) -> str:
                          f"[{row['completion']:>4.0%}] {deg:<7}")
         lines.append(f"{name:<{width}}  " +
                      "  ".join(f"{c:<{cell_w}}" for c in cells))
+    lines.append(f"jobs lost across the matrix: {report['jobs_lost']} "
+                 f"(conservation contract: must be 0)")
+    return "\n".join(lines)
+
+
+# ---- generalization matrix (ISSUE 14) ---------------------------------------
+
+# the canonical eval axis of ``evaluate --matrix``: fixed-cluster control,
+# mild load/duration jitter, heterogeneous hardware, sustained 1.6×
+# overload — the measured weak spot (BASELINE.md) the matrix tracks as a
+# number next to JCT
+MATRIX_REGIMES = ("none", "baseline", "hetero", "overload")
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _matrix_cell(apply_fn, env_params, max_steps, net_params, traces,
+                 faults):
+    """One jitted matrix cell: greedy policy replay over a domain-schedule
+    batch. Module-level with static (apply_fn, env_params, max_steps) so
+    every column of a policy row hits ONE compile cache entry — the
+    zero-retrace-across-domains contract (same recipe as FAULT_REGIMES:
+    every regime's :class:`~.domains.DomainSchedule` has identical
+    shapes/treedef, so only the data changes between cells)."""
+    return replay(apply_fn, net_params, env_params, traces, max_steps,
+                  return_states=True, faults=faults)
+
+
+def matrix_report(exp, regimes: tuple[str, ...] = MATRIX_REGIMES,
+                  baselines: tuple[str, ...] = ("sjf", "tiresias"),
+                  policies: dict[str, tuple] | None = None,
+                  max_steps: int | None = None, seed: int = 0,
+                  bus=None, registry=None, alarms=None) -> dict[str, Any]:
+    """The train-regime × eval-regime generalization matrix
+    (``evaluate --matrix``): replay one or more trained policies AND the
+    oracle baselines under identical ``(seed, env)``-seeded domain draws
+    — randomized cluster geometry, heterogeneous speeds, and arrival
+    regimes up to sustained overload — one row per scheduler, one column
+    per eval regime, with **degradation vs the fixed-cluster control**
+    (regime JCT / 'none' JCT, per scheduler) as the headline. "Does the
+    policy trained on one cluster still schedule a cluster it never saw"
+    is the generalization question this matrix makes measurable; the
+    ``overload`` column turns the measured 1.6×-overload weakness
+    (BASELINE.md) into a tracked number next to JCT.
+
+    Every cell in a column shares the SAME windows and the same batched
+    :class:`~.domains.DomainSchedule` (env ``e`` draws ``(seed, e)``
+    under the column's spec; windows are generated against each draw's
+    ACTUAL capacity by ``experiment.make_domain_windows``), so the
+    comparison is apples-to-apples per column. The fixed-cluster control
+    ("none") is always evaluated (prepended when not requested) because
+    degradation is relative to it.
+
+    ``policies``: ``{row_name: (apply_fn, net_params, env_params)}`` —
+    extra rows for checkpoints trained under other regimes
+    (``evaluate --matrix-ckpt``); default is the experiment's own policy.
+    Per-row ``env_params`` may differ in observation channels only
+    (a domain-sighted checkpoint sees geometry/health, a blind one does
+    not); the sim geometry must match — every row replays the same
+    cluster draws.
+
+    Every cell enforces the no-jobs-lost conservation contract against
+    the DRAWN per-node capacity (:func:`_chaos_conservation`).
+    Reproducibility tuple: ``(seed, regime, n_nodes, gpus_per_node,
+    window config)``.
+
+    ``bus`` (:class:`obs.EventBus`) emits one ``domain_cell`` event per
+    cell plus per-regime draw stats; ``registry`` gains
+    ``matrix_<regime>_<scheduler>_*`` gauges. ``alarms``
+    (:class:`obs.telemetry.Alarms`, already entered) wraps each jitted
+    cell dispatch: after the warmup cell, a recompile or implicit
+    transfer in any cell is an alarm event — each ADDITIONAL policy row's
+    first cell legitimately compiles its own program (different
+    observation space) and is granted ``expect_recompile`` amnesty."""
+    from .domains import (domain_schedule, domain_stats, resolve_domain,
+                          sample_env_domains, stack_domain_schedules,
+                          validate_domain_schedule)
+    from .experiment import make_domain_windows
+    if isinstance(exp.env_params, HierParams):
+        raise ValueError("the generalization matrix supports flat configs "
+                         "(domain schedules carry per-node capacity "
+                         "through the flat sim path only)")
+    cfg = exp.cfg
+    n_nodes, g = cfg.n_nodes, cfg.gpus_per_node
+    if policies is None:
+        policies = {"policy": (exp.apply_fn, exp.train_state.params,
+                               exp.env_params)}
+    for pname, (_, _, ep) in policies.items():
+        if isinstance(ep, HierParams) or ep.sim != exp.env_params.sim:
+            raise ValueError(
+                f"matrix row {pname!r} has a different sim geometry than "
+                f"the experiment; every row must replay the same cluster "
+                f"draws (rows may differ in observation channels only)")
+    regimes = list(dict.fromkeys(["none", *regimes]))
+    # matrix draws and windows are governed by the MATRIX seed, not the
+    # training seed — the repro tuple records it
+    mcfg = dataclasses.replace(cfg, seed=int(seed))
+
+    report: dict[str, Any] = {
+        "matrix_seed": int(seed), "matrix_regimes": list(regimes),
+        "jobs_lost": 0, "cells": {}, "domain_stats": {}}
+    # one column's data is built ONCE and shared by every row
+    columns: dict[str, tuple] = {}
+    for rname in regimes:
+        spec = resolve_domain(rname)
+        draws = sample_env_domains(spec, n_nodes, g, seed, cfg.n_envs)
+        windows = make_domain_windows(mcfg, draws)
+        host = [validate_domain_schedule(n_nodes, g, domain_schedule(d))
+                for d in draws]
+        batched = stack_domain_schedules(host)
+        traces = env_lib.stack_traces(windows, exp.env_params)
+        columns[rname] = (windows, host, batched, traces)
+        stats = [domain_stats(d) for d in draws]
+        report["domain_stats"][rname] = {
+            "mean_total_gpus": float(np.mean([s["total_gpus"]
+                                              for s in stats])),
+            "envs_with_nodes_off": int(sum(s["n_nodes_off"] > 0
+                                           for s in stats)),
+            "envs_hetero": int(sum(s["n_hetero"] > 0 for s in stats)),
+            "max_slowdown": float(max(s["max_slowdown"] for s in stats)),
+            "mean_load": float(np.mean([s["load"] for s in stats])),
+        }
+        report["cells"][rname] = {}
+
+    dispatch = 0
+    for pi, (pname, (apply_fn, params, ep)) in enumerate(policies.items()):
+        params = jax.device_put(params)
+        for ci, rname in enumerate(regimes):
+            _, _, batched, traces = columns[rname]
+            if alarms is not None and ci == 0 and pi > 0:
+                alarms.expect_recompile(
+                    f"matrix row {pname!r}: first cell compiles its own "
+                    f"replay program (different observation space)")
+            ctx = (alarms.dispatch(dispatch) if alarms is not None
+                   else contextlib.nullcontext())
+            with ctx:
+                res, states = _matrix_cell(apply_fn, ep, max_steps,
+                                           params, traces, batched)
+                jax.block_until_ready(res.avg_jct)
+            dispatch += 1
+            cons = _chaos_conservation(states, traces, ep, faults=batched)
+            if not cons["conserved"]:
+                raise AssertionError(
+                    f"conservation violated in matrix cell "
+                    f"({pname!r}, {rname!r}): {cons} — a domain draw must "
+                    f"shrink or slow the cluster, never leak jobs or "
+                    f"GPUs")
+            report["jobs_lost"] += cons["jobs_lost"]
+            jct, completion = pooled_avg_jct(res)
+            report["cells"][rname][pname] = {"avg_jct": jct,
+                                             "completion": completion}
+    for bname in baselines:
+        for rname in regimes:
+            windows, host, _, _ = columns[rname]
+            jcts, n_valid = [], 0
+            for w, fs in zip(windows, host):
+                bl = run_baseline(w, n_nodes, g, bname, faults=fs)
+                jcts.append(bl.jcts())
+                n_valid += w.num_jobs
+            pooled = np.concatenate(jcts) if jcts else np.zeros(0)
+            report["cells"][rname][bname] = {
+                "avg_jct": (float(pooled.mean()) if pooled.size else 0.0),
+                "completion": float(pooled.size / max(n_valid, 1))}
+
+    clean = report["cells"]["none"]
+    for rname, cols in report["cells"].items():
+        for sched, row in cols.items():
+            base = clean[sched]["avg_jct"]
+            row["degradation"] = (row["avg_jct"] / base
+                                  if base and np.isfinite(base) else None)
+    for rname, cols in report["cells"].items():
+        for sched, row in cols.items():
+            if bus is not None:
+                bus.emit("domain_cell", regime=rname, scheduler=sched,
+                         avg_jct=round(row["avg_jct"], 3),
+                         completion=round(row["completion"], 4),
+                         degradation=(round(row["degradation"], 4)
+                                      if row["degradation"] is not None
+                                      else None),
+                         matrix_seed=int(seed),
+                         **{f"domain_{k}": v for k, v in
+                            report["domain_stats"][rname].items()})
+            if registry is not None:
+                stem = f"matrix_{rname}_{sched}"
+                registry.gauge(f"{stem}_avg_jct").set(row["avg_jct"])
+                registry.gauge(f"{stem}_completion").set(
+                    row["completion"])
+                if row["degradation"] is not None:
+                    registry.gauge(f"{stem}_degradation").set(
+                        row["degradation"])
+    return report
+
+
+def format_matrix(report: dict[str, Any]) -> str:
+    """Human-readable generalization matrix: one row per eval regime, one
+    column per scheduler, each cell ``avg JCT [completion]
+    ×degradation-vs-none``."""
+    regimes = list(report["cells"])
+    scheds = list(next(iter(report["cells"].values())))
+    width = max(len("eval regime"), *(len(r) for r in regimes))
+    cell_w = 24
+    lines = [f"generalization matrix (seed {report['matrix_seed']}) — "
+             f"avg JCT s [completion] ×degradation-vs-none:",
+             f"{'eval regime':<{width}}  " +
+             "  ".join(f"{s:<{cell_w}}" for s in scheds)]
+    for name in regimes:
+        cells = []
+        for s in scheds:
+            row = report["cells"][name][s]
+            deg = (f"×{row['degradation']:.2f}"
+                   if row["degradation"] is not None else "×—")
+            cells.append(f"{row['avg_jct']:>8.1f} "
+                         f"[{row['completion']:>4.0%}] {deg:<7}")
+        lines.append(f"{name:<{width}}  " +
+                     "  ".join(f"{c:<{cell_w}}" for c in cells))
+    for name in regimes:
+        st = report["domain_stats"][name]
+        lines.append(f"  {name}: ~{st['mean_total_gpus']:.1f} GPUs/env, "
+                     f"{st['envs_with_nodes_off']} envs with nodes off, "
+                     f"{st['envs_hetero']} hetero, "
+                     f"max slowdown ×{st['max_slowdown']:.1f}, "
+                     f"load {st['mean_load']:.2f}")
     lines.append(f"jobs lost across the matrix: {report['jobs_lost']} "
                  f"(conservation contract: must be 0)")
     return "\n".join(lines)

@@ -17,6 +17,7 @@ Examples::
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import json
 import sys
@@ -70,6 +71,62 @@ def build_parser() -> argparse.ArgumentParser:
                         "observation space — same contract as the "
                         "cluster-shape overrides). Evaluation itself "
                         "stays clean unless --chaos is passed")
+    p.add_argument("--domains", default=None, metavar="REGIME",
+                   help="config override matching a --domains TRAINING "
+                        "run (the geometry/health channels are part of "
+                        "the checkpointed observation space — same "
+                        "contract as --faults). Evaluation itself stays "
+                        "on the fixed cluster unless --matrix is passed")
+    p.add_argument("--matrix", action="store_true",
+                   help="generalization matrix: replay the policy (plus "
+                        "any --matrix-ckpt rows) AND the oracle "
+                        "baselines under identical seeded DOMAIN draws "
+                        "— randomized geometry, heterogeneous speeds, "
+                        "arrival regimes up to 1.6× overload — and "
+                        "report per-cell avg JCT, completion, and "
+                        "DEGRADATION vs the fixed-cluster control — "
+                        "flat configs")
+    p.add_argument("--matrix-regimes", default=None, metavar="A,B,...",
+                   help="with --matrix: comma-separated eval-regime "
+                        "subset (domains.DOMAIN_REGIMES); the "
+                        "fixed-cluster 'none' control is always included")
+    p.add_argument("--matrix-baselines", default="sjf,tiresias",
+                   metavar="A,B,...",
+                   help="with --matrix: baseline scheduler rows next to "
+                        "the policy (sim.schedulers.BASELINES)")
+    p.add_argument("--matrix-seed", type=int, default=0,
+                   help="with --matrix: base seed of the domain draws "
+                        "and generated windows (env e draws (seed, e)); "
+                        "recorded in the JSON repro tuple")
+    p.add_argument("--matrix-ckpt", action="append", default=None,
+                   metavar="REGIME=DIR",
+                   help="with --matrix: add a policy row restored from "
+                        "DIR, trained under --domains REGIME (use "
+                        "'clean' for a checkpoint trained without "
+                        "domains). Repeatable — the train-regime × "
+                        "eval-regime cross table. Cluster shape must "
+                        "match the --config")
+    p.add_argument("--alarms", action="store_true",
+                   help="with --matrix --obs-dir: production alarm scope "
+                        "over the jitted matrix cells — a post-warmup "
+                        "recompile or implicit transfer becomes an alarm "
+                        "event (obs.report --strict-alarms gates on "
+                        "them); the zero-retrace-across-domains contract, "
+                        "enforced in CI")
+    p.add_argument("--stitch-faults", default=None, metavar="REGIME",
+                   help="with --full-trace: run the WHOLE stitched table "
+                        "(policy rows and baselines) under one seeded "
+                        "global-time fault schedule of this regime "
+                        "(sim.faults.FAULT_REGIMES)")
+    p.add_argument("--stitch-domain", default=None, metavar="REGIME",
+                   help="with --full-trace: run the whole stitched table "
+                        "on one seeded domain draw of this regime "
+                        "(domains.DOMAIN_REGIMES) — heterogeneous "
+                        "speeds / shrunken geometry; composes with "
+                        "--stitch-faults (worst slowdown wins per node)")
+    p.add_argument("--stitch-seed", type=int, default=0,
+                   help="with --stitch-faults/--stitch-domain: seed of "
+                        "the schedule draw; recorded in the repro tuple")
     p.add_argument("--chaos", action="store_true",
                    help="chaos evaluation matrix: replay the policy AND "
                         "the oracle baselines under identical seeded "
@@ -90,10 +147,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "draws (env e draws (seed, e)); recorded in the "
                         "JSON repro tuple")
     p.add_argument("--obs-dir", default=None,
-                   help="with --chaos: emit per-cell env_fault events "
-                        "(JSONL event bus) and chaos_* gauges "
-                        "(metrics.prom) under this directory so "
-                        "obs.report can tell the chaos story")
+                   help="with --chaos/--matrix: emit per-cell events "
+                        "(env_fault / domain_cell, JSONL event bus) and "
+                        "chaos_*/matrix_* gauges (metrics.prom) under "
+                        "this directory so obs.report can tell the "
+                        "story")
     p.add_argument("--trace-spans", action="store_true",
                    help="with --chaos --obs-dir: flight recorder — "
                         "record each regime row as nested "
@@ -194,7 +252,8 @@ def main(argv: list[str] | None = None) -> dict:
              "window_jobs": args.window_jobs, "queue_len": args.queue_len,
              "horizon": args.horizon, "obs_kind": args.obs_kind,
              "drain_frac": args.drain_frac,
-             "faults": args.faults}.items() if v is not None}
+             "faults": args.faults,
+             "domains": args.domains}.items() if v is not None}
     cfg = dataclasses.replace(cfg, **over)
     if args.source_jobs is not None:
         if args.source_jobs <= 0:
@@ -235,14 +294,81 @@ def main(argv: list[str] | None = None) -> dict:
         if bad:
             sys.exit(f"unknown --chaos-baselines {bad}; known: "
                      f"{sorted(BASELINES)}")
-    elif args.chaos_regimes is not None or args.obs_dir:
-        sys.exit("--chaos-regimes/--obs-dir configure the --chaos "
-                 "matrix; pass --chaos with them (refusing the silent "
-                 "no-op)")
+    elif args.chaos_regimes is not None:
+        sys.exit("--chaos-regimes configures the --chaos matrix; pass "
+                 "--chaos with it (refusing the silent no-op)")
+    if args.obs_dir and not (args.chaos or args.matrix):
+        sys.exit("--obs-dir serves the --chaos and --matrix flows; pass "
+                 "one of them with it (refusing the silent no-op)")
     if args.trace_spans and not (args.chaos and args.obs_dir):
         sys.exit("--trace-spans records spans on the chaos event bus; "
                  "pass --chaos and --obs-dir with it (refusing the "
                  "silent no-op)")
+
+    if args.matrix:
+        if (args.chaos or args.pbt or args.fairness or args.full_trace
+                or args.baselines_only or args.percentiles
+                or args.backlog_gate or cfg.n_pods > 1):
+            sys.exit("--matrix is its own train-regime × eval-regime "
+                     "table over generated domain windows (flat "
+                     "configs): no --chaos/--pbt/--fairness/"
+                     "--full-trace/--baselines-only/--percentiles/"
+                     "--backlog-gate")
+        if args.eval_windows is not None:
+            sys.exit("--matrix generates its own window batch per "
+                     "regime; size it with --n-envs")
+        from .domains import DOMAIN_REGIMES
+        from .sim.schedulers import BASELINES
+        matrix_regimes = (tuple(s for s in args.matrix_regimes.split(",")
+                                if s)
+                          if args.matrix_regimes else None)
+        matrix_baselines = tuple(
+            s for s in args.matrix_baselines.split(",") if s)
+        bad = [r for r in (matrix_regimes or ()) if r not in
+               DOMAIN_REGIMES]
+        if bad:
+            sys.exit(f"unknown --matrix-regimes {bad}; known: "
+                     f"{sorted(DOMAIN_REGIMES)}")
+        bad = [b for b in matrix_baselines if b not in BASELINES]
+        if bad:
+            sys.exit(f"unknown --matrix-baselines {bad}; known: "
+                     f"{sorted(BASELINES)}")
+        matrix_ckpts = []
+        for spec in args.matrix_ckpt or []:
+            regime, sep, path = spec.partition("=")
+            if not sep or not path or (regime != "clean" and
+                                       regime not in DOMAIN_REGIMES):
+                sys.exit(f"--matrix-ckpt wants REGIME=DIR with REGIME "
+                         f"in {sorted(DOMAIN_REGIMES)} or 'clean' "
+                         f"(got {spec!r})")
+            matrix_ckpts.append((regime, path))
+    elif (args.matrix_regimes is not None or args.matrix_ckpt
+          or args.matrix_seed != 0 or args.alarms):
+        sys.exit("--matrix-regimes/--matrix-ckpt/--matrix-seed/--alarms "
+                 "configure the --matrix table; pass --matrix with them "
+                 "(refusing the silent no-op)")
+    if args.alarms and not args.obs_dir:
+        sys.exit("--alarms raises its events on the --obs-dir bus; pass "
+                 "--obs-dir with it")
+
+    if (args.stitch_faults or args.stitch_domain) and not args.full_trace:
+        sys.exit("--stitch-faults/--stitch-domain degrade the "
+                 "--full-trace stitched replay; pass --full-trace with "
+                 "them (refusing the silent no-op)")
+    if args.stitch_seed != 0 and not (args.stitch_faults or
+                                      args.stitch_domain):
+        sys.exit("--stitch-seed seeds the --stitch-faults/--stitch-domain "
+                 "draw; pass one of them with it")
+    if args.stitch_faults is not None:
+        from .sim.faults import FAULT_REGIMES
+        if args.stitch_faults not in FAULT_REGIMES:
+            sys.exit(f"unknown --stitch-faults {args.stitch_faults!r}; "
+                     f"known: {sorted(FAULT_REGIMES)}")
+    if args.stitch_domain is not None:
+        from .domains import DOMAIN_REGIMES
+        if args.stitch_domain not in DOMAIN_REGIMES:
+            sys.exit(f"unknown --stitch-domain {args.stitch_domain!r}; "
+                     f"known: {sorted(DOMAIN_REGIMES)}")
 
     # the full reproducibility tuple every evaluate JSON carries: enough
     # to regenerate any row (chaos-matrix rows included) exactly —
@@ -359,6 +485,59 @@ def main(argv: list[str] | None = None) -> dict:
                                chaos_baselines=list(chaos_baselines))
         print(json.dumps(report))
         return report
+    if args.matrix:
+        import os
+
+        from .eval import MATRIX_REGIMES, format_matrix, matrix_report
+        # the experiment's own row, labeled by its training regime
+        own = cfg.domains or "clean"
+        policies = {own: (exp.apply_fn, exp.train_state.params,
+                          exp.env_params)}
+        for regime, path in matrix_ckpts:
+            label = regime if regime not in policies else \
+                f"{regime}@{len(policies)}"
+            rcfg = dataclasses.replace(
+                cfg, domains=None if regime == "clean" else regime)
+            rexp = Experiment.build(rcfg)
+            from .checkpoint import Checkpointer
+            with Checkpointer(os.path.abspath(path)) as ck:
+                rexp.restore_checkpoint(ck, step=None)
+            print(f"matrix row {label!r} restored from {path}",
+                  file=sys.stderr)
+            policies[label] = (rexp.apply_fn, rexp.train_state.params,
+                               rexp.env_params)
+        bus = registry = alarms = None
+        if args.obs_dir:
+            from .obs import EventBus, Registry
+            bus = EventBus(os.path.abspath(args.obs_dir), rank=0,
+                           name="matrix")
+            registry = Registry()
+            if args.alarms:
+                from .obs import Alarms
+                alarms = Alarms(bus, registry, warmup_iters=1,
+                                transfer_guard=True)
+        try:
+            with (alarms if alarms is not None
+                  else contextlib.nullcontext()):
+                report = matrix_report(
+                    exp, regimes=matrix_regimes or MATRIX_REGIMES,
+                    baselines=matrix_baselines, policies=policies,
+                    max_steps=args.max_steps, seed=args.matrix_seed,
+                    bus=bus, registry=registry, alarms=alarms)
+        finally:
+            if bus is not None:
+                bus.close()
+        if registry is not None:
+            registry.write(os.path.join(os.path.abspath(args.obs_dir),
+                                        "metrics.prom"))
+        print(format_matrix(report), file=sys.stderr)
+        report["repro"] = dict(
+            repro, matrix_seed=args.matrix_seed,
+            matrix_regimes=report["matrix_regimes"],
+            matrix_baselines=list(matrix_baselines),
+            matrix_ckpts=[f"{r}={p}" for r, p in matrix_ckpts])
+        print(json.dumps(report))
+        return report
     if args.fairness:
         report = fairness_report(exp, max_steps=args.max_steps)
         print(format_fairness(report), file=sys.stderr)
@@ -388,6 +567,25 @@ def main(argv: list[str] | None = None) -> dict:
                 exp.env_params, sim=dataclasses.replace(
                     exp.env_params.sim,
                     max_jobs=args.stitch_window_jobs))
+        stitch_schedule = None
+        if args.stitch_faults or args.stitch_domain:
+            from .sim.faults import (fault_horizon, resolve_regime,
+                                     sample_fault_schedule)
+            if args.stitch_faults:
+                stitch_schedule = sample_fault_schedule(
+                    cfg.n_nodes, resolve_regime(args.stitch_faults),
+                    (args.stitch_seed,), fault_horizon([exp.source]))
+            if args.stitch_domain:
+                from .domains import (domain_schedule, domain_stats,
+                                      resolve_domain, sample_domain)
+                draw = sample_domain(resolve_domain(args.stitch_domain),
+                                     cfg.n_nodes, cfg.gpus_per_node,
+                                     (args.stitch_seed,))
+                stitch_schedule = domain_schedule(draw, stitch_schedule)
+                repro["stitch_domain_draw"] = domain_stats(draw)
+            repro["stitch_faults"] = args.stitch_faults
+            repro["stitch_domain"] = args.stitch_domain
+            repro["stitch_seed"] = args.stitch_seed
         report = full_trace_report(exp, max_jobs=args.max_jobs,
                                    include_random=not args.no_random,
                                    percentiles=PERCENTILES
@@ -395,7 +593,8 @@ def main(argv: list[str] | None = None) -> dict:
                                    env_params=stitch_params,
                                    backlog_gate=args.backlog_gate,
                                    stall_guard=args.stall_guard,
-                                   drain_completions=args.stitch_drain_jobs)
+                                   drain_completions=args.stitch_drain_jobs,
+                                   faults=stitch_schedule)
     else:
         eval_windows = None
         if args.eval_windows is not None and \

@@ -20,10 +20,14 @@ from .algos.ppo import make_optimizer
 from .configs import ExperimentConfig
 from .env import EnvParams, build_adjacency, stack_traces
 from .models import make_policy
+from .domains import (domain_schedule, resolve_domain, sample_env_domains,
+                      stack_domain_schedules, validate_domain_schedule)
 from .sim.core import SimParams, validate_trace
 from .sim.faults import (fault_horizon, resolve_regime,
-                         sample_env_fault_schedules)
-from .traces import (ArrayTrace, gen_poisson_trace, load_pai, load_philly)
+                         sample_env_fault_schedules, sample_fault_schedule)
+from .traces import (ArrayTrace, gen_domain_window, gen_poisson_trace,
+                     load_pai, load_philly)
+from .traces.fit import domain_fit
 from flax.training.train_state import TrainState
 
 
@@ -33,6 +37,7 @@ def build_env_params(cfg: ExperimentConfig) -> EnvParams:
                     n_placements=cfg.n_placements,
                     preempt_len=cfg.preempt_len)
     fault_process = resolve_regime(cfg.faults) if cfg.faults else None
+    domain_process = resolve_domain(cfg.domains) if cfg.domains else None
     return EnvParams(sim=sim, obs_kind=cfg.obs_kind,
                      reward_kind=cfg.reward_kind, n_tenants=cfg.n_tenants,
                      time_scale=cfg.time_scale, reward_scale=cfg.reward_scale,
@@ -42,9 +47,15 @@ def build_env_params(cfg: ExperimentConfig) -> EnvParams:
                      # per-node health rides the FLAT observation only
                      # (grid/graph pin their feature layouts); those
                      # encoders still train on fault dynamics, blind to
-                     # which node is sick
-                     fault_obs=(fault_process is not None
-                                and cfg.obs_kind == "flat"))
+                     # which node is sick. Domain runs always carry a
+                     # DomainSchedule (with a possibly-heterogeneous
+                     # slowdown) so they get the health channel too
+                     fault_obs=((fault_process is not None
+                                 or domain_process is not None)
+                                and cfg.obs_kind == "flat"),
+                     domain_process=domain_process,
+                     domain_obs=(domain_process is not None
+                                 and cfg.obs_kind == "flat"))
 
 
 def load_source_trace(cfg: ExperimentConfig, n_jobs: int | None = None,
@@ -94,6 +105,11 @@ def build_stack(cfg: ExperimentConfig):
             raise ValueError(
                 "hierarchical configs have no fault-process support yet "
                 "(sim.faults is a flat-config feature); unset faults")
+        if cfg.domains:
+            raise ValueError(
+                "hierarchical configs have no domain-randomization "
+                "support yet (domain schedules carry per-node capacity "
+                "through the flat sim path only); unset domains")
         if cfg.n_nodes % cfg.n_pods != 0:
             raise ValueError(f"n_nodes={cfg.n_nodes} not divisible by "
                              f"n_pods={cfg.n_pods}")
@@ -127,7 +143,17 @@ def build_stack(cfg: ExperimentConfig):
     env_params = build_env_params(cfg)
     source = validate_trace(env_params.sim, load_source_trace(cfg),
                             clamp=True)
-    windows = make_env_windows(cfg, source)
+    if env_params.domain_process is not None:
+        # domain windows are GENERATED per env from the config's fitted
+        # job mix under each env's seeded domain draw (arrival knobs +
+        # that draw's actual capacity), not cut from the source — the
+        # source stays loaded so --full-trace/window accounting on the
+        # same config keep working
+        draws = sample_env_domains(env_params.domain_process, cfg.n_nodes,
+                                   cfg.gpus_per_node, cfg.seed, cfg.n_envs)
+        windows = make_domain_windows(cfg, draws)
+    else:
+        windows = make_env_windows(cfg, source)
     traces = stack_traces(windows, env_params)
     net = make_policy(cfg.obs_kind, env_params.n_actions,
                       n_cluster_nodes=cfg.n_nodes, queue_len=cfg.queue_len,
@@ -190,6 +216,34 @@ def make_env_windows(cfg: ExperimentConfig, source: ArrayTrace,
     return windows
 
 
+def make_domain_windows(cfg: ExperimentConfig, draws, start: int = 0,
+                        ) -> list[ArrayTrace]:
+    """The domain-randomized twin of :func:`make_env_windows`: one
+    GENERATED window per env from the config's fitted job mix
+    (``traces.fit.domain_fit``) under that env's :class:`DomainDraw` —
+    offered load against the draw's ACTUAL capacity, duration scaling,
+    diurnal/burst arrivals, gang mix renormalized to what the shrunken
+    cluster can place. ``start`` is the window-streaming cursor: window
+    seeds are ``(cfg.seed, env, start)``, so advancing the cursor draws
+    fresh windows of identical shape (no recompilation), and a
+    checkpoint restore at a cursor regenerates bit-identical windows.
+    The drain-curriculum tail works exactly like the env-window path."""
+    fit = domain_fit(cfg)
+    windows = []
+    for e, d in enumerate(draws):
+        total = d.total_gpus
+        windows.append(gen_domain_window(
+            fit, cfg.window_jobs, (cfg.seed, e, start), n_gpus=total,
+            load=d.load, duration_scale=d.duration_scale,
+            burst_frac=d.burst_frac, diurnal=d.diurnal, max_gang=total,
+            n_tenants=max(cfg.n_tenants, 1)))
+    n = len(windows)     # the matrix evaluates draw batches != n_envs
+    n_drain = int(round(n * cfg.drain_frac))
+    for e in range(n - n_drain, n):
+        windows[e] = drain_window(windows[e])
+    return windows
+
+
 @dataclasses.dataclass
 class Experiment:
     """Assembled experiment: jitted train step + host loop."""
@@ -210,8 +264,15 @@ class Experiment:
     # batched per-env sim.faults.FaultSchedule [E, ...] (cfg.faults), or
     # None = healthy cluster. DATA like the traces: threaded through the
     # jitted step as an argument, never closed over, so schedules can
-    # change without recompiling
+    # change without recompiling. Under cfg.domains this slot holds the
+    # batched domains.DomainSchedule instead (a strict superset the
+    # fault consumers read field-by-field), composing any cfg.faults
+    # draw into its windows/slowdown
     faults: Any = None
+    # host list[domains.DomainDraw] (cfg.domains), or None: the per-env
+    # draws behind self.faults, kept so window streaming can regenerate
+    # windows under the SAME cluster draws at a new cursor
+    domains: Any = None
     # unified Mesh(pop × data × model) the step was rule-sharded against
     # (parallel.sharding), or None = plain single-program jit
     mesh: Any = None
@@ -222,12 +283,31 @@ class Experiment:
         env_params, windows, traces, net, apply_fn, extra, source = \
             build_stack(cfg)
         faults = None
-        if getattr(env_params, "fault_process", None) is not None:
+        domains = None
+        fp = getattr(env_params, "fault_process", None)
+        if getattr(env_params, "domain_process", None) is not None:
+            # the SAME seeded draws build_stack generated windows from
+            # (host sampling is deterministic in (seed, env)); the
+            # device data is one batched DomainSchedule riding the
+            # faults slot, composing any cfg.faults draw per env
+            domains = sample_env_domains(
+                env_params.domain_process, cfg.n_nodes, cfg.gpus_per_node,
+                cfg.seed, cfg.n_envs)
+            horizon_s = fault_horizon(windows)
+            schedules = []
+            for e, d in enumerate(domains):
+                f = (sample_fault_schedule(cfg.n_nodes, fp, (cfg.seed, e),
+                                           horizon_s)
+                     if fp is not None else None)
+                schedules.append(validate_domain_schedule(
+                    cfg.n_nodes, cfg.gpus_per_node, domain_schedule(d, f)))
+            faults = stack_domain_schedules(schedules)
+        elif fp is not None:
             # seeded per-env draws over the window batch's time span, so
             # drain windows intersect live episodes at every trace scale
             faults = sample_env_fault_schedules(
-                cfg.n_nodes, env_params.fault_process, cfg.seed,
-                cfg.n_envs, fault_horizon(windows))
+                cfg.n_nodes, fp, cfg.seed, cfg.n_envs,
+                fault_horizon(windows))
         key = jax.random.PRNGKey(cfg.seed)
         key, init_key, carry_key = jax.random.split(key, 3)
         algo_cfg = cfg.ppo if cfg.algo == "ppo" else cfg.a2c
@@ -306,7 +386,8 @@ class Experiment:
                           traces=traces, net=net, apply_fn=apply_fn,
                           train_state=train_state, train_step=jit_step,
                           carry=carry, key=key, source=source,
-                          train_step_raw=step_fn, faults=faults, mesh=mesh)
+                          train_step_raw=step_fn, faults=faults,
+                          domains=domains, mesh=mesh)
 
     @property
     def steps_per_iteration(self) -> int:
@@ -398,7 +479,9 @@ class Experiment:
         argument). Sharding of the previous traces is preserved so DP runs
         stay sharded."""
         self.window_cursor = cursor
-        windows = make_env_windows(self.cfg, self.source, cursor)
+        windows = (make_domain_windows(self.cfg, self.domains, cursor)
+                   if self.domains is not None
+                   else make_env_windows(self.cfg, self.source, cursor))
         sim_params = (self.env_params.sim
                       if isinstance(self.env_params, EnvParams)
                       else self.env_params.pod_sim)
@@ -725,6 +808,11 @@ class PopulationExperiment:
     mesh: Any = None         # unified Mesh when members ride the pop axis
     state_sharding: Any = None    # rule-resolved member-stack layout
     hparam_sharding: Any = None   # [P] hparam layout (pop axis)
+    # batched per-member per-env FaultSchedule [P, E, ...] (cfg.faults),
+    # or None: each member draws its own seeded (seed, member, env)
+    # schedules, so the population covers the regime P×E-wide. Not
+    # checkpointed — deterministically regenerated from cfg at build
+    faults: Any = None
 
     @staticmethod
     def build(cfg: ExperimentConfig, n_pop: int = 4, mesh=None,
@@ -738,11 +826,14 @@ class PopulationExperiment:
                 f"PopulationExperiment trains PPO members (PBT explores "
                 f"PPO hyperparameters); config {cfg.name!r} has "
                 f"algo={cfg.algo!r}")
-        if cfg.faults:
+        if cfg.domains:
+            # configs.MODE_REFUSALS carries the pbt×domains row for the
+            # CLI; programmatic builders must refuse just as loudly
             raise ValueError(
-                "PopulationExperiment does not thread fault schedules "
-                "through the vmapped member step yet; train chaos "
-                "policies on single-run configs (cfg.faults=None)")
+                "PopulationExperiment does not thread domain schedules: "
+                "per-member domain draws would need member-indexed trace "
+                "windows through the population stack (cfg.domains=None; "
+                "cfg.faults is supported)")
         pbt_cfg = pbt_cfg or PBTConfig(seed=cfg.seed)
         resolve_geometry(cfg.ppo.n_epochs, cfg.ppo.n_minibatches,
                          cfg.ppo.minibatch_size,
@@ -753,11 +844,30 @@ class PopulationExperiment:
         # env windows (PBT fitness comparability) and the vmapped step
         # broadcasts them (in_axes=None) instead of holding n_pop copies
 
+        # per-member per-env fault schedules [P, E, ...]: member p's env e
+        # draws from (seed, p, e), so the population covers the regime
+        # P×E-wide while every member trains on the SAME trace windows
+        # (fitness stays comparable in expectation — same regime,
+        # independent draws)
+        member_faults = None
+        fp = getattr(env_params, "fault_process", None)
+        if fp is not None:
+            from .sim.faults import stack_fault_schedules
+            horizon_s = fault_horizon(windows)
+            member_faults = [
+                stack_fault_schedules(
+                    [sample_fault_schedule(cfg.n_nodes, fp,
+                                           (cfg.seed, p, e), horizon_s)
+                     for e in range(cfg.n_envs)])
+                for p in range(n_pop)]
+
         key = jax.random.PRNGKey(cfg.seed)
         member_keys = jax.random.split(key, n_pop * 3).reshape(n_pop, 3, 2)
         members, carries = [], []
         for p in range(n_pop):
-            carry = init_carry(env_params, traces, member_keys[p, 1])
+            carry = init_carry(env_params, traces, member_keys[p, 1],
+                               member_faults[p] if member_faults is not None
+                               else None)
             ex_obs, ex_mask = jax.tree.map(lambda x: x[:1],
                                            (carry.obs, carry.mask))
             members.append(init_member(net, member_keys[p, 0], ex_obs,
@@ -767,8 +877,11 @@ class PopulationExperiment:
         stacked_carries = stack_members(carries)
         hparams = sample_hparams(cfg.ppo, n_pop, cfg.seed)
         keys = member_keys[:, 2]
+        faults = (stack_members(member_faults)
+                  if member_faults is not None else None)
 
-        pop_step = make_population_step(apply_fn, env_params, cfg.ppo)
+        pop_step = make_population_step(apply_fn, env_params, cfg.ppo,
+                                        with_faults=faults is not None)
         if mesh is not None:
             if n_pop % mesh.shape["pop"] != 0:
                 raise ValueError(f"n_pop={n_pop} not divisible by pop axis "
@@ -783,7 +896,8 @@ class PopulationExperiment:
             from .parallel.population import population_shardings
             rules = shardlib.rules_for(cfg)
             jitted = jit_population_step(mesh, pop_step, states=states,
-                                         rules=rules)
+                                         rules=rules,
+                                         with_faults=faults is not None)
             st_sh, ca_sh, tr_sh, key_sh, hp_sh = population_shardings(
                 mesh, states=states, rules=rules)
             states = jax.device_put(states, st_sh)
@@ -791,6 +905,9 @@ class PopulationExperiment:
             traces = jax.device_put(traces, tr_sh)
             keys = jax.device_put(keys, key_sh)
             hparams = jax.device_put(hparams, hp_sh)
+            if faults is not None:
+                from .parallel.mesh import pop_env_sharded
+                faults = jax.device_put(faults, pop_env_sharded(mesh))
             return PopulationExperiment(
                 cfg=cfg, n_pop=n_pop, env_params=env_params,
                 traces=traces, apply_fn=apply_fn, states=states,
@@ -798,13 +915,14 @@ class PopulationExperiment:
                 pop_step=jitted,
                 controller=PBTController(n_pop, pbt_cfg),
                 windows=windows, mesh=mesh, state_sharding=st_sh,
-                hparam_sharding=hp_sh)
+                hparam_sharding=hp_sh, faults=faults)
         jitted = jax.jit(pop_step, donate_argnums=(0, 1))
         return PopulationExperiment(
             cfg=cfg, n_pop=n_pop, env_params=env_params, traces=traces,
             apply_fn=apply_fn, states=states, carries=stacked_carries,
             hparams=hparams, keys=keys, pop_step=jitted,
-            controller=PBTController(n_pop, pbt_cfg), windows=windows)
+            controller=PBTController(n_pop, pbt_cfg), windows=windows,
+            faults=faults)
 
     @property
     def steps_per_iteration(self) -> int:
@@ -968,10 +1086,13 @@ class PopulationExperiment:
                      else contextlib.nullcontext())
             both = split_all(self.keys)
             self.keys, subs = both[:, 0], both[:, 1]
+            step_args = (self.states, self.carries, self.traces, subs,
+                         self.hparams)
+            if self.faults is not None:
+                step_args = step_args + (self.faults,)
             with sections("step"), tracer.span("step"), guard:
                 self.states, self.carries, metrics = self.pop_step(
-                    self.states, self.carries, self.traces, subs,
-                    self.hparams)
+                    *step_args)
             if injector is not None:
                 metrics = injector.poison_nan_member(self, i, metrics)
             fitness = metrics.mean_reward

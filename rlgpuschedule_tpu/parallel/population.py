@@ -127,9 +127,10 @@ def make_member_step(apply_fn: PolicyApply, env_params: EnvParams,
     learn = make_member_learn_step(apply_fn, config)
 
     def member_step(state: MemberState, carry: RolloutCarry, traces,
-                    key: jax.Array, hp: HParams):
+                    key: jax.Array, hp: HParams, faults=None):
         carry, tr, last_value = rollout(apply_fn, state.params, env_params,
-                                        traces, carry, config.n_steps)
+                                        traces, carry, config.n_steps,
+                                        faults)
         state, metrics = learn(state, tr, last_value, key, hp)
         return state, carry, metrics
 
@@ -137,16 +138,23 @@ def make_member_step(apply_fn: PolicyApply, env_params: EnvParams,
 
 
 def make_population_step(apply_fn: PolicyApply, env_params: EnvParams,
-                         config: PPOConfig) -> Callable:
+                         config: PPOConfig,
+                         with_faults: bool = False) -> Callable:
     """vmap the member step over the stacked population axis:
-    (states[P], carries[P], traces, keys[P], hps[P]) ->
+    (states[P], carries[P], traces, keys[P], hps[P][, faults[P, E]]) ->
     (states', carries', metrics[P]).
 
     ``traces`` is NOT stacked per member (``in_axes=None``): every member
     trains on the same env windows (PBT fitness must be comparable), so the
     trace lives once — replicated across ``pop``, env-sharded over
-    ``data``."""
+    ``data``. Fault schedules, by contrast, ARE member-stacked
+    (``with_faults``): each member draws its own per-env schedules
+    (seeded (seed, member, env)), so the population covers the fault
+    distribution P×E-wide while fitness stays comparable in expectation
+    (same regime, independent draws)."""
     member = make_member_step(apply_fn, env_params, config)
+    if with_faults:
+        return jax.vmap(member, in_axes=(0, 0, None, 0, 0, 0))
     return jax.vmap(member, in_axes=(0, 0, None, 0, 0))
 
 
@@ -207,13 +215,18 @@ def population_shardings(mesh: Mesh, states: MemberState | None = None,
 
 def jit_population_step(mesh: Mesh, pop_step: Callable,
                         states: MemberState | None = None,
-                        rules=None) -> Callable:
+                        rules=None, with_faults: bool = False) -> Callable:
     state_sh, carry_sh, trace_sh, key_sh, hp_sh = population_shardings(
         mesh, states, rules)
+    in_sh = (state_sh, carry_sh, trace_sh, key_sh, hp_sh)
+    if with_faults:
+        # per-member [P, E] schedule stacks lay out like the carries:
+        # member axis over pop, env axis over data
+        in_sh = in_sh + (pop_env_sharded(mesh),)
     metrics_sh = jax.tree.map(lambda _: pop_sharded(mesh),
                               PPOMetrics(*[0.0] * len(PPOMetrics._fields)))
     return jax.jit(pop_step,
-                   in_shardings=(state_sh, carry_sh, trace_sh, key_sh, hp_sh),
+                   in_shardings=in_sh,
                    out_shardings=(state_sh, carry_sh, metrics_sh),
                    donate_argnums=(0, 1))
 

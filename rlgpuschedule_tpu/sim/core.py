@@ -130,8 +130,17 @@ class StepInfo(NamedTuple):
 
 # ---- lifecycle --------------------------------------------------------------
 
-def init_state(params: SimParams, trace: Trace) -> SimState:
+def init_state(params: SimParams, trace: Trace,
+               faults: "FaultSchedule | None" = None) -> SimState:
     J, N = params.max_jobs, params.n_nodes
+    # a DomainSchedule (domains.schedule) carries per-node GPU capacity as
+    # data — geometry randomization without retracing; a plain
+    # FaultSchedule (or None) has no capacity attribute and the free
+    # vector stays the bit-identical static full cluster
+    cap = getattr(faults, "capacity", None)
+    free = (jnp.full((N,), params.gpus_per_node, jnp.int32) if cap is None
+            # copy=True for the same donation-aliasing reason as remaining
+            else jnp.array(cap, jnp.int32, copy=True))
     state = SimState(
         clock=jnp.float32(0.0),
         status=jnp.where(trace.valid, NOT_ARRIVED, DONE).astype(jnp.int32),
@@ -142,7 +151,7 @@ def init_state(params: SimParams, trace: Trace) -> SimState:
         start=jnp.full((J,), INF, jnp.float32),
         finish=jnp.full((J,), INF, jnp.float32),
         alloc=jnp.zeros((J, N), jnp.int32),
-        free=jnp.full((N,), params.gpus_per_node, jnp.int32),
+        free=free,
     )
     return _process_arrivals(state, trace)
 

@@ -92,7 +92,18 @@ class OracleSim:
         self.trace = trace
         self.n_nodes = n_nodes
         self.gpus_per_node = gpus_per_node
-        self.capacity = n_nodes * gpus_per_node
+        # a DomainSchedule in the faults slot carries per-node capacity
+        # (geometry randomization); extract it BEFORE validation, which
+        # normalizes down to the plain 3-field fault triple
+        cap = getattr(faults, "capacity", None)
+        self.node_capacity = (np.full(n_nodes, gpus_per_node, np.int32)
+                              if cap is None
+                              else np.asarray(cap, np.int32).copy())
+        if self.node_capacity.shape != (n_nodes,):
+            raise ValueError(
+                f"domain capacity must have shape ({n_nodes},); got "
+                f"{self.node_capacity.shape}")
+        self.capacity = int(self.node_capacity.sum())
         if trace.num_jobs and int(trace.gpus[trace.valid].max()) > self.capacity:
             raise ValueError("a job demands more GPUs than the cluster has")
         self.faults = None
@@ -109,7 +120,7 @@ class OracleSim:
         self.start = np.full(J, np.nan)
         self.finish = np.full(J, np.nan)
         self.alloc = np.zeros((J, self.n_nodes), np.int32)
-        self.free = np.full(self.n_nodes, self.gpus_per_node, np.int32)
+        self.free = self.node_capacity.copy()
         self._process_arrivals()
         return self
 
@@ -323,4 +334,4 @@ class OracleSim:
     def gpus_consistent(self) -> bool:
         """Conservation invariant: allocated + free == capacity, per node."""
         used = self.alloc.sum(axis=0)
-        return bool((used + self.free == self.gpus_per_node).all())
+        return bool((used + self.free == self.node_capacity).all())

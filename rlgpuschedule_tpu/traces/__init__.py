@@ -6,6 +6,8 @@ from .philly import load_philly, load_philly_jobs
 from .pai import load_pai, load_pai_jobs
 from .philly_proxy import (gen_philly_proxy_jobs, gen_philly_proxy_trace,
                            gen_pai_proxy_jobs, gen_pai_proxy_trace)
+from .fit import (TraceFit, fit_jobs, domain_fit, gen_domain_window,
+                  PHILLY_FIT, PAI_FIT)
 
 __all__ = [
     "JobRecord", "ArrayTrace", "to_array_trace", "from_array_trace",
@@ -14,4 +16,6 @@ __all__ = [
     "load_philly", "load_philly_jobs", "load_pai", "load_pai_jobs",
     "gen_philly_proxy_jobs", "gen_philly_proxy_trace",
     "gen_pai_proxy_jobs", "gen_pai_proxy_trace",
+    "TraceFit", "fit_jobs", "domain_fit", "gen_domain_window",
+    "PHILLY_FIT", "PAI_FIT",
 ]

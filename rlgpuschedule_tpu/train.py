@@ -79,6 +79,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "the rollout next to the traces; flat configs "
                         "also expose per-node health in the observation. "
                         "Evaluate the result with evaluate --chaos")
+    p.add_argument("--domains", default=None, metavar="REGIME",
+                   help="domain randomization: train ONE policy across a "
+                        "seeded distribution of clusters — randomized "
+                        "geometry (per-node capacity), heterogeneous "
+                        "hardware speeds, and arrival regimes up to "
+                        "sustained overload (domains.DOMAIN_REGIMES: "
+                        "none/baseline/geom/hetero/overload/flash/"
+                        "mixed); per-env draws ride the fault-schedule "
+                        "slot, windows are GENERATED from the trace's "
+                        "fitted job mix against each draw's actual "
+                        "capacity. Composes with --faults (worst "
+                        "slowdown wins per node). Evaluate the result "
+                        "with evaluate --matrix")
     # algorithm hyperparameter overrides (apply to the active algo's
     # config — cfg.ppo or cfg.a2c; None = keep preset value). Large-batch
     # TPU runs typically want a higher --lr than the preset 3e-4, which
@@ -294,7 +307,8 @@ def apply_overrides(cfg: ExperimentConfig,
               "trace_load": args.trace_load,
               "source_jobs": args.source_jobs,
               "resample_every": args.resample_every,
-              "drain_frac": args.drain_frac, "faults": args.faults}
+              "drain_frac": args.drain_frac, "faults": args.faults,
+              "domains": args.domains}
     cfg = dataclasses.replace(
         cfg, **{k: v for k, v in fields.items() if v is not None})
     algo_fields = {"lr": args.lr, "ent_coef": args.ent_coef,
@@ -525,6 +539,11 @@ def main(argv: list[str] | None = None) -> dict:
         if args.faults not in FAULT_REGIMES:
             sys.exit(f"unknown --faults regime {args.faults!r}; known: "
                      f"{sorted(FAULT_REGIMES)}")
+    if args.domains is not None:
+        from .domains import DOMAIN_REGIMES
+        if args.domains not in DOMAIN_REGIMES:
+            sys.exit(f"unknown --domains regime {args.domains!r}; known: "
+                     f"{sorted(DOMAIN_REGIMES)}")
     if args.mesh != "off" and args.mesh != "auto" \
             and not re.fullmatch(r"\d+x\d+x\d+", args.mesh):
         sys.exit(f"bad --mesh {args.mesh!r}: expected off, auto, or an "
@@ -567,6 +586,7 @@ def main(argv: list[str] | None = None) -> dict:
             "async": args.async_run,
             "pbt": args.pbt,
             "faults": args.faults is not None,
+            "domains": cfg.domains is not None,
             "fault_injection": bool(faults),
             "fused_chunk": args.fused_chunk > 1,
             "rollbacks": args.max_rollbacks is not None,

@@ -432,12 +432,17 @@ class TestEvaluateCLI:
                                "--chaos-regimes", "meteor"])
 
     def test_train_faults_refusals(self):
-        with pytest.raises(SystemExit):   # unknown regime
+        with pytest.raises(SystemExit):   # unknown fault regime
             train_cli.main(["--config", "ppo-mlp-synth64", *FAST,
                             "--faults", "meteor"])
-        with pytest.raises(SystemExit):   # population path unsupported
+        with pytest.raises(SystemExit):   # unknown domain regime
             train_cli.main(["--config", "ppo-mlp-synth64", *FAST,
-                            "--faults", "sporadic", "--pbt"])
+                            "--domains", "meteor"])
+        # --faults x --pbt is SUPPORTED since the domain PR (per-member
+        # (seed, member, env) schedules); --domains x --pbt is not
+        with pytest.raises(SystemExit):
+            train_cli.main(["--config", "ppo-mlp-synth64", *FAST,
+                            "--domains", "mixed", "--pbt"])
 
 
 class TestMinibatchSweep:

@@ -5,6 +5,10 @@ population as a mesh axis vs the per-member Python loop — both on
 forced-CPU virtual devices with a zero-post-warmup-recompile
 CompileCounter gate."""
 
+import os
+import subprocess
+import sys
+
 import numpy as np
 import pytest
 import jax
@@ -377,9 +381,35 @@ class TestFusedUnderMesh:
     the per-step build — not input-inferred shardings — so the fused
     path is bit-identical to the per-step rule path given the same key
     stream, keeps the rule-table NamedSharding layout on its outputs,
-    and never recompiles on a repeated fused length."""
+    and never recompiles on a repeated fused length.
+
+    Collected only inside the clean-interpreter subprocess spawned by
+    :func:`test_fused_under_mesh_isolated` (the ``__test__`` gate below):
+    compiling the fused MULTI-device SPMD program on the forced-8-device
+    CPU backend after a long heap-churning session (anything after
+    test_serve) SIGABRT/SIGSEGVs the whole pytest process on jax 0.4.37
+    — it reproduces on a pristine checkout, with the persistent compile
+    cache on OR off, and MALLOC_CHECK_ heisenbugs it away, i.e. latent
+    native heap damage surfacing at the biggest multi-device compile. A
+    fresh interpreter running just this class is deterministically
+    green, so that is the only supported way to run it in-suite."""
+
+    __test__ = os.environ.get("RLGS_FUSED_MESH_INPROC") == "1"
 
     ITERS = 3
+
+    @pytest.fixture(autouse=True)
+    def _no_persistent_cache(self):
+        # independent of the in-process crash above, the persistent
+        # compile cache's multi-device executable ROUND-TRIP is itself
+        # flaky on this backend (the jax 0.4.37 bug ci.sh works around
+        # with JAX_ENABLE_COMPILATION_CACHE=false on its mesh smokes) —
+        # pay the recompile instead of betting the run on a deserialize
+        import jax as _jax
+        prev = _jax.config.jax_enable_compilation_cache
+        _jax.config.update("jax_enable_compilation_cache", False)
+        yield
+        _jax.config.update("jax_enable_compilation_cache", prev)
 
     def _build(self):
         import dataclasses
@@ -428,3 +458,33 @@ class TestFusedUnderMesh:
         assert cc.total == 0, (
             f"fused-under-mesh recompiled on a repeated length: "
             f"{cc.traces} traces, {cc.backend_compiles} compiles")
+
+
+def test_fused_under_mesh_isolated():
+    """Run :class:`TestFusedUnderMesh` in a fresh interpreter (see its
+    docstring for why in-process is not survivable on jax 0.4.37) and
+    fail with its full output if anything inside fails. One retry, ONLY
+    on a signal death (negative returncode): the fresh process dodges
+    the heap-state trigger but the underlying XLA:CPU bug is still
+    nondeterministic native code — a genuine test failure (rc > 0) is
+    never retried."""
+    env = dict(os.environ,
+               RLGS_FUSED_MESH_INPROC="1",
+               JAX_ENABLE_COMPILATION_CACHE="false")
+    cmd = [sys.executable, "-m", "pytest",
+           f"{__file__}::TestFusedUnderMesh", "-q", "-m", "not slow",
+           "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"]
+    for attempt in (1, 2):
+        res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                             timeout=420)
+        if res.returncode == 0:
+            # rc 0 with nothing collected would be a silent coverage
+            # hole (e.g. the __test__ gate broke); pytest exits 5 on
+            # "no tests ran", but belt-and-braces the success line
+            assert " passed" in res.stdout, res.stdout
+            return
+        if res.returncode > 0:
+            break                       # real failure inside the class
+    pytest.fail(
+        f"isolated fused-under-mesh run failed (rc {res.returncode}, "
+        f"attempt {attempt}):\n{res.stdout[-4000:]}\n{res.stderr[-4000:]}")

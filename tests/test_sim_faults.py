@@ -414,10 +414,21 @@ class TestEnvAndTrainingWiring:
         out = exp.run(log_every=1)
         assert np.isfinite(out["history"][-1]["total_loss"])
 
-    def test_population_refuses_faults(self):
+    def test_population_trains_under_faults(self):
+        # ISSUE 14 satellite: PBT x faults is a supported pair now —
+        # member p's env e draws its schedule from (seed, p, e), so the
+        # population covers the regime P×E-wide on shared trace windows
         from rlgpuschedule_tpu.experiment import PopulationExperiment
-        with pytest.raises(ValueError, match="fault"):
-            PopulationExperiment.build(self._cfg(), n_pop=2)
+        pop = PopulationExperiment.build(self._cfg(), n_pop=2)
+        assert pop.faults is not None
+        down = np.asarray(jax.tree.leaves(pop.faults)[0])
+        assert down.shape[:2] == (2, 2)    # [P, E, ...] leading axes
+        # independent draws per (member, env): not one broadcast schedule
+        flat = down.reshape(4, -1)
+        assert len({a.tobytes() for a in flat}) > 1
+        out = pop.run(2)
+        assert len(out["final_fitness"]) == 2
+        assert all(np.isfinite(f) for f in out["final_fitness"])
 
     def test_hier_refuses_faults(self):
         from rlgpuschedule_tpu.experiment import Experiment
