@@ -251,6 +251,21 @@ def bench_async(cfg, args, platform: str, iters: int) -> None:
 
 def main() -> None:
     args = build_parser().parse_args()
+    # the refusal table is the contract for flag interactions: --mesh is
+    # a sync-loop layout and --correction an async-loop knob, so the
+    # cross combinations refuse up front instead of silently ignoring
+    # one flag (import stays lazy — the CPU re-exec path runs first)
+    from rlgpuschedule_tpu.configs import (ModeCombinationError,
+                                           validate_mode_combination)
+    try:
+        validate_mode_combination({
+            "async": args.async_run,
+            "mesh": args.mesh != "off",
+            "vtrace": args.correction == "vtrace",
+            "sync": not args.async_run,
+        })
+    except ModeCombinationError as e:
+        raise SystemExit(str(e))
     if args.sweep is not None:
         if args.n_epochs != 2 or args.n_minibatches != 8 \
                 or args.minibatch_size is not None:

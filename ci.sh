@@ -5,16 +5,22 @@
 # tier-1 pytest gate.
 #
 # Stage 1 — lint (fast, no JAX import for jsan's AST pass):
-#   1a. jsan: the repo's JAX-pitfall static analyzer. Scope is the
-#       package + the top-level entry scripts. tests/ is NOT scanned:
-#       single-shot jit(lambda) in a test body is benign (each test
-#       compiles once by design) and tests/fixtures/ holds jsan's own
-#       deliberately-bad corpus. Baseline: jsan_baseline.json.
+#   1a. jsan: the repo's JAX-pitfall + concurrency static analyzer.
+#       Scope is the package + the top-level entry scripts. tests/ is
+#       NOT scanned: single-shot jit(lambda) in a test body is benign
+#       (each test compiles once by design) and tests/fixtures/ holds
+#       jsan's own deliberately-bad corpus. Baseline:
+#       jsan_baseline.json (EMPTY since PR 15), run with --fail-stale
+#       so the baseline can only shrink. A second jsan invocation emits
+#       SARIF and sanity-checks its shape — the code-scanning upload
+#       must never receive a malformed document.
 #   1b. ruff + mypy at the pyproject.toml config, pinned there
 #       (ruff==0.6.9, mypy==1.11.2). Both gate on availability: the
 #       hermetic CI image does not ship them, and the lint stage must
 #       not mutate the environment by installing things — when absent
-#       they are SKIPPED LOUDLY, not failed.
+#       they are SKIPPED LOUDLY, not failed. When PRESENT, the version
+#       must match the pin exactly: a drifted linter silently applies
+#       different rules, which is worse than no linter.
 #
 # Stage 2 — the tier-1 gate (ROADMAP.md), split in two: the main pass
 #   excludes the multihost_spawn subset, which then runs SERIALLY after
@@ -30,10 +36,38 @@ cd "$(dirname "$0")"
 echo "=== lint 1/3: jsan (python -m rlgpuschedule_tpu.analysis) ==="
 python -m rlgpuschedule_tpu.analysis \
     rlgpuschedule_tpu bench.py __graft_entry__.py \
-    --baseline jsan_baseline.json
+    --baseline jsan_baseline.json --fail-stale
+
+echo "=== lint 1/3b: jsan SARIF gate ==="
+JSAN_SARIF=$(mktemp /tmp/ci_jsan.XXXXXX.sarif)
+python -m rlgpuschedule_tpu.analysis \
+    rlgpuschedule_tpu bench.py __graft_entry__.py \
+    --baseline jsan_baseline.json --format sarif > "$JSAN_SARIF"
+python - "$JSAN_SARIF" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["version"] == "2.1.0", doc.get("version")
+assert "sarif-schema-2.1.0" in doc["$schema"]
+run, = doc["runs"]
+assert run["tool"]["driver"]["name"] == "jsan"
+rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+for res in run["results"]:
+    assert res["ruleId"] in rule_ids, res["ruleId"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] and loc["region"]["startLine"] >= 1
+print(f"sarif ok: {len(run['results'])} result(s), "
+      f"{len(rule_ids)} rules declared")
+PY
+rm -f "$JSAN_SARIF"
 
 echo "=== lint 2/3: ruff ==="
 if command -v ruff >/dev/null 2>&1; then
+    want=$(sed -n 's/^#   ruff==//p' pyproject.toml)
+    have=$(ruff --version | awk '{print $2}')
+    if [ "$have" != "$want" ]; then
+        echo "FAIL: ruff $have installed but pyproject.toml pins ruff==$want" >&2
+        exit 1
+    fi
     ruff check rlgpuschedule_tpu tests
 else
     echo "SKIP: ruff not installed (pinned ruff==0.6.9 in pyproject.toml)"
@@ -41,6 +75,12 @@ fi
 
 echo "=== lint 3/3: mypy ==="
 if command -v mypy >/dev/null 2>&1; then
+    want=$(sed -n 's/^#   mypy==//p' pyproject.toml)
+    have=$(mypy --version | awk '{print $2}')
+    if [ "$have" != "$want" ]; then
+        echo "FAIL: mypy $have installed but pyproject.toml pins mypy==$want" >&2
+        exit 1
+    fi
     mypy
 else
     echo "SKIP: mypy not installed (pinned mypy==1.11.2 in pyproject.toml)"

@@ -1,33 +1,115 @@
 """jsan CLI: ``python -m rlgpuschedule_tpu.analysis [paths...]``.
 
-Exit codes: 0 clean (after suppressions + baseline), 1 findings, 2 bad
-invocation. The default baseline is ``jsan_baseline.json`` in the
-current directory when it exists (the committed grandfather list — see
-README "Static analysis"); ``--no-baseline`` shows everything,
-``--write-baseline`` regenerates the file from the current findings.
+Exit codes are a contract CI scripts rely on: **0** clean (after
+suppressions + baseline), **1** findings (or stale baseline entries
+under ``--fail-stale``), **2** anything that prevented a verdict — bad
+invocation, unreadable/unparsable input, a broken baseline file, a git
+failure under ``--diff``, or an internal analyzer error (traceback on
+stderr). "No verdict" is never conflated with "findings": a wrapper
+that treats 1 as "block the merge" must not block on an analyzer crash
+it should instead report.
+
+The default baseline is ``jsan_baseline.json`` in the current directory
+when it exists (the committed grandfather list — see README "Static
+analysis"); ``--no-baseline`` shows everything, ``--write-baseline``
+regenerates the file, ``--prune-baseline`` drops entries whose finding
+no longer exists, ``--fail-stale`` turns such stale entries into a
+failure (ci.sh runs with it so the baseline can only shrink).
+
+``--format sarif`` emits SARIF 2.1.0 for code-scanning upload;
+``--diff BASE`` restricts analysis to files changed since a git rev;
+``--explain RULE`` prints a rule's full rationale (its module
+docstring).
+
+Every text-mode finding carries a stable ID ``<rule>@<path>@<hash>``
+(hash of the offending source line, so it survives line drift) — the
+same identity the baseline uses.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
+import traceback
 
-from .engine import (analyze_paths, apply_baseline, load_baseline,
-                     make_baseline)
+from .engine import (analyze_paths, apply_baseline, iter_py_files,
+                     load_baseline, make_baseline)
 from .rules import all_rules, rule_names
 
 DEFAULT_BASELINE = "jsan_baseline.json"
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                 "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _explain(rule_name: str) -> int:
+    for rule in all_rules():
+        if rule.name == rule_name:
+            doc = sys.modules[rule.check.__module__].__doc__ or rule.summary
+            print(f"{rule.name}: {rule.summary}\n")
+            print(doc.strip())
+            return 0
+    print(f"jsan: unknown rule {rule_name!r} (see --list-rules)",
+          file=sys.stderr)
+    return 2
+
+
+def _diff_paths(base: str, paths: list[str]) -> list[str]:
+    """The requested files changed since ``base`` (git's repo-relative
+    names intersected with the expansion of ``paths``)."""
+    proc = subprocess.run(["git", "diff", "--name-only", base, "--"],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"git diff --name-only {base} failed: "
+                           f"{proc.stderr.strip() or proc.returncode}")
+    changed = {os.path.normpath(line.strip())
+               for line in proc.stdout.splitlines()
+               if line.strip().endswith(".py")}
+    return [p for p in iter_py_files(paths)
+            if os.path.normpath(p) in changed]
+
+
+def _sarif(findings) -> dict:
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "jsan",
+                "informationUri":
+                    "https://github.com/rlgpuschedule/rlgpuschedule-tpu",
+                "rules": [{"id": r.name,
+                           "shortDescription": {"text": r.summary}}
+                          for r in all_rules()],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "partialFingerprints": {"jsanFindingId/v1": f.finding_id},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                }}],
+            } for f in findings],
+        }],
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m rlgpuschedule_tpu.analysis",
-        description="jsan: JAX-pitfall static analyzer (see README "
-                    "'Static analysis' for rules and workflow)")
+        description="jsan: JAX-pitfall + concurrency static analyzer "
+                    "(see README 'Static analysis' for rules and "
+                    "workflow)")
     p.add_argument("paths", nargs="*", default=["rlgpuschedule_tpu"],
                    help="files or directories to analyze (default: "
                         "rlgpuschedule_tpu)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
                    help=f"baseline JSON of grandfathered findings "
                         f"(default: {DEFAULT_BASELINE}; silently empty "
@@ -37,6 +119,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--write-baseline", metavar="PATH", default=None,
                    help="write the current findings as a baseline to "
                         "PATH and exit 0")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="rewrite the baseline file keeping only entries "
+                        "that still match a finding, then exit 0")
+    p.add_argument("--fail-stale", action="store_true",
+                   help="fail (exit 1) when the baseline contains "
+                        "entries no current finding matches")
+    p.add_argument("--diff", metavar="BASE", default=None,
+                   help="only analyze files changed since the git rev "
+                        "BASE (intersected with the requested paths)")
+    p.add_argument("--explain", metavar="RULE", default=None,
+                   help="print a rule's full rationale and exit")
     p.add_argument("--list-rules", action="store_true")
     args = p.parse_args(argv)
 
@@ -44,15 +137,34 @@ def main(argv: list[str] | None = None) -> int:
         for rule in all_rules():
             print(f"{rule.name}: {rule.summary}")
         return 0
+    if args.explain:
+        return _explain(args.explain)
 
     try:
-        findings = analyze_paths(args.paths)
+        if args.diff is not None:
+            paths = _diff_paths(args.diff, args.paths)
+            if not paths:
+                print(f"jsan: no analyzable files changed since "
+                      f"{args.diff}")
+                return 0
+        else:
+            paths = args.paths
+        findings = analyze_paths(paths)
     except FileNotFoundError as e:
         print(f"jsan: no such path: {e}", file=sys.stderr)
         return 2
     except SyntaxError as e:
         print(f"jsan: cannot parse {e.filename}:{e.lineno}: {e.msg}",
               file=sys.stderr)
+        return 2
+    except RuntimeError as e:
+        print(f"jsan: {e}", file=sys.stderr)
+        return 2
+    except Exception:
+        # an analyzer bug must read as "no verdict", never as "clean"
+        # or "findings" — dump the traceback and use the error exit
+        print("jsan: internal error:", file=sys.stderr)
+        traceback.print_exc(file=sys.stderr)
         return 2
 
     if args.write_baseline:
@@ -64,6 +176,7 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     baselined = 0
+    stale: list[tuple[str, str, str]] = []
     if not args.no_baseline:
         try:
             baseline = load_baseline(args.baseline)
@@ -73,9 +186,24 @@ def main(argv: list[str] | None = None) -> int:
             print(f"jsan: bad baseline {args.baseline}: {e}",
                   file=sys.stderr)
             return 2
+        live_keys = {f.baseline_key for f in findings}
+        stale = sorted(baseline - live_keys)
         kept = apply_baseline(findings, baseline)
         baselined = len(findings) - len(kept)
         findings = kept
+
+        if args.prune_baseline:
+            pruned = make_baseline([])
+            pruned["entries"] = [{"rule": r, "path": p_, "snippet": s}
+                                 for r, p_, s in sorted(
+                                     baseline & live_keys)]
+            with open(args.baseline, "w", encoding="utf-8") as f:
+                json.dump(pruned, f, indent=2, sort_keys=True)
+                f.write("\n")
+            print(f"jsan: pruned {len(stale)} stale entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} from "
+                  f"{args.baseline} ({len(baseline) - len(stale)} kept)")
+            return 0
 
     if args.format == "json":
         print(json.dumps(
@@ -83,13 +211,22 @@ def main(argv: list[str] | None = None) -> int:
              "baselined": baselined, "rules": rule_names(),
              "findings": [f.as_dict() for f in findings]},
             indent=2, sort_keys=True))
+    elif args.format == "sarif":
+        print(json.dumps(_sarif(findings), indent=2, sort_keys=True))
     else:
         for f in findings:
             print(f"{f.path}:{f.line}:{f.col + 1}: [{f.rule}] {f.message}")
             if f.snippet:
                 print(f"    {f.snippet}")
+            print(f"    id: {f.finding_id}")
         tail = f" ({baselined} baselined)" if baselined else ""
         print(f"jsan: {len(findings)} finding(s){tail}")
+
+    if stale and args.fail_stale:
+        for r, p_, s in stale:
+            print(f"jsan: stale baseline entry [{r}] {p_}: {s!r} "
+                  f"(run --prune-baseline)", file=sys.stderr)
+        return 1
     return 1 if findings else 0
 
 

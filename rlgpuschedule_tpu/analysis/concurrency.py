@@ -1,0 +1,460 @@
+"""jsan's thread-aware interprocedural model (ISSUE 15 tentpole).
+
+The five concurrency rules share one per-module model built here:
+
+**Thread roots** — the functions whose bodies run off the main thread:
+
+1. any resolvable ``threading.Thread(target=...)`` target (a module
+   function, a ``self.method``, a nested closure, a lambda);
+2. the first argument of an executor-style ``*.submit(fn, ...)`` call,
+   when it resolves to a local function (an opaque first argument is
+   NOT a root — ``PolicyServer.submit(obs, mask)`` must not count);
+3. the dispatcher/actor loop naming convention: ``loop``, ``*_loop``,
+   ``*_worker`` — this repo's thread bodies (``_actor_loop``, the
+   dispatcher ``loop``) follow it, and factoring a thread body into a
+   helper must not silently drop it out of the model. Convention roots
+   only arm in modules that import ``threading`` or
+   ``concurrent.futures`` (so ``analysis/rules/_in_loop`` helpers and
+   host-side ``fused_loop`` benchmarks stay out).
+
+**Call reachability** — an intra-module call graph over ``f(...)``,
+``self.m(...)``, and one hop of attribute tracking: ``self._q.put(...)``
+resolves through ``self._q = LocalClass(...)`` to ``LocalClass.put``
+(how the actor loop reaches ``TrajectoryQueue.put``). Cross-module
+edges are out of scope, consistent with the engine's per-module
+stance — every finding points at local evidence, and the runtime
+sentinels backstop the recall gap.
+
+**Lock regions** — a lock is any name/attribute assigned from
+``threading.Lock/RLock/Semaphore/BoundedSemaphore/Condition``, with two
+idioms this codebase relies on recognized explicitly:
+
+- ``threading.Lock() if on_cpu else contextlib.nullcontext()`` — the
+  conditional dispatch lock (``async_engine``, ``serve/router``);
+- ``threading.Condition(self._lock)`` — a Condition *aliasing* the lock
+  it wraps (``PolicyServer._wake`` IS ``PolicyServer._lock``), so code
+  holding either holds the same region.
+
+A ``with`` statement's items mark the lexically held region
+(multi-item ``with a, b, lock:`` included). On top of that, a
+**lock-protected-function fixpoint** computes each function's
+*effective* locks — the intersection over every call site of the locks
+held there plus the caller's own effective locks — so a helper only
+ever called under ``self._lock`` (``PolicyServer._shed_expired``)
+counts as locked without a lexical ``with`` of its own.
+
+**Program tracking** — assignments of ``jax.jit(...)`` / ``jax.pmap``
+results and ``.lower(...).compile()`` chains are tracked as compiled
+executables (through one level of local-variable indirection:
+``rollout_jit = jax.jit(...)`` then ``self._rollout =
+rollout_jit.lower(...).compile()`` marks ``self._rollout``), with
+``donate_argnums``/``donate_argnames`` donation-ness carried along.
+Queue-typed attributes (``queue.Queue`` constructions or local classes
+named ``*Queue*``) are tracked for the blocking rule.
+"""
+from __future__ import annotations
+
+import ast
+
+from .engine import ModuleContext
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Semaphore",
+               "threading.BoundedSemaphore"}
+_CONDITION = "threading.Condition"
+_THREAD_CTORS = {"threading.Thread", "threading.Timer"}
+_JIT_CTORS = {"jax.jit", "jax.pmap"}
+_QUEUE_CTORS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+                "queue.SimpleQueue", "multiprocessing.Queue"}
+_DONATE_KW = {"donate_argnums", "donate_argnames"}
+_CONVENTION_GATE = {"threading", "concurrent.futures", "concurrent"}
+
+# the main thread, as a pseudo-root for rules that compare writer
+# threads (construction-time code and public entry points run here)
+MAIN = "<main>"
+
+
+def _outer_name(fn: ast.AST) -> str:
+    return getattr(fn, "name", "<lambda>")
+
+
+class ConcurrencyModel:
+    """Thread roots, call reachability, lock regions, and tracked
+    compiled/donated/queue objects for ONE module (built once per
+    :class:`ModuleContext`, shared by every concurrency rule)."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self._class_of: dict[ast.AST, ast.ClassDef | None] = {}
+        self.classes_by_name: dict[str, ast.ClassDef] = {
+            n.name: n for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.ClassDef)}
+        self._methods: dict[tuple[int, str], list[ast.AST]] = {}
+        for cls in self.classes_by_name.values():
+            for stmt in cls.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._methods.setdefault(
+                        (id(cls), stmt.name), []).append(stmt)
+        self._build_locks()
+        self._build_value_tokens()
+        self._build_roots()
+        self._build_edges()
+        self._build_reach()
+        self._build_effective_locks()
+
+    # -- class binding ------------------------------------------------------
+    def class_of(self, fn: ast.AST) -> ast.ClassDef | None:
+        """The class whose ``self`` an enclosing-method chain binds (a
+        closure inside a method still sees the method's ``self``)."""
+        if fn in self._class_of:
+            return self._class_of[fn]
+        cls = None
+        for anc in self.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                cls = anc
+                break
+        self._class_of[fn] = cls
+        return cls
+
+    # -- value tokens -------------------------------------------------------
+    # identity for "the same object" across a module: ("attr", id(class),
+    # name) for self-attributes, ("var", name) for plain names (scopes
+    # merged — precision is recovered by the per-class attr key where it
+    # matters)
+    def value_token(self, expr: ast.AST, near: ast.AST):
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            fn = near if isinstance(near, _FuncNode) \
+                else self.ctx.enclosing_function(near)
+            cls = self.class_of(fn) if fn is not None else None
+            if cls is not None:
+                return ("attr", id(cls), expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            return ("var", expr.id)
+        return None
+
+    # -- locks --------------------------------------------------------------
+    def _lock_kind(self, expr: ast.AST):
+        """None | "new" | ("alias", expr): classify an assigned value as
+        a fresh lock, an alias of another lock (Condition(lock)), or not
+        a lock at all."""
+        if isinstance(expr, ast.Call):
+            name = self.ctx.resolve(expr.func)
+            if name in _LOCK_CTORS:
+                return "new"
+            if name == _CONDITION:
+                return ("alias", expr.args[0]) if expr.args else "new"
+            return None
+        if isinstance(expr, ast.IfExp):
+            # threading.Lock() if on_cpu else contextlib.nullcontext()
+            if (self._lock_kind(expr.body) is not None
+                    or self._lock_kind(expr.orelse) is not None):
+                return "new"
+        return None
+
+    def _build_locks(self) -> None:
+        defs: list[tuple[tuple, object]] = []
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                value = node.value
+                if value is None:
+                    continue
+                kind = self._lock_kind(value)
+                if kind is None:
+                    continue
+                for t in targets:
+                    tok = self.value_token(t, node)
+                    if tok is not None:
+                        defs.append((tok, kind))
+        self.lock_tokens: set[tuple] = {t for t, k in defs if k == "new"}
+        self._canon: dict[tuple, tuple] = {t: t for t in self.lock_tokens}
+        # resolve Condition(lock) aliases (possibly chained) to the
+        # wrapped lock's token; an alias of something untracked is a
+        # lock in its own right
+        pending = [(t, k[1]) for t, k in defs if isinstance(k, tuple)]
+        for _ in range(len(pending) + 1):
+            rest = []
+            for tok, target_expr in pending:
+                ttok = self.value_token(target_expr, target_expr)
+                if ttok in self._canon:
+                    self._canon[tok] = self._canon[ttok]
+                    self.lock_tokens.add(tok)
+                else:
+                    rest.append((tok, target_expr))
+            done = len(rest) == len(pending)
+            pending = rest
+            if done:
+                break
+        for tok, target_expr in pending:
+            self._canon[tok] = tok
+            self.lock_tokens.add(tok)
+
+    def canonical_lock(self, tok: tuple) -> tuple | None:
+        return self._canon.get(tok)
+
+    def held_at(self, node: ast.AST) -> frozenset[tuple]:
+        """Canonical lock tokens lexically held at ``node`` — ``with``
+        ancestors inside the node's own function (a ``with`` outside a
+        nested ``def`` does not protect the def's later execution)."""
+        held: set[tuple] = set()
+        for anc in self.ctx.ancestors(node):
+            if isinstance(anc, _FuncNode):
+                break
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                for item in anc.items:
+                    tok = self.value_token(item.context_expr, node)
+                    if tok is not None and tok in self._canon:
+                        held.add(self._canon[tok])
+        return frozenset(held)
+
+    def locks_at(self, node: ast.AST) -> frozenset[tuple]:
+        """Lexical locks at ``node`` plus the enclosing function's
+        effective (caller-guaranteed) locks."""
+        fn = node if isinstance(node, _FuncNode) \
+            else self.ctx.enclosing_function(node)
+        eff = self.effective_locks.get(fn, frozenset()) \
+            if fn is not None else frozenset()
+        return self.held_at(node) | eff
+
+    def lock_name(self, tok: tuple) -> str:
+        return f"self.{tok[2]}" if tok[0] == "attr" else tok[1]
+
+    # -- tracked compiled / donated / queue objects -------------------------
+    def _jit_call_in(self, expr: ast.AST) -> ast.Call | None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) \
+                    and self.ctx.resolve(node.func) in _JIT_CTORS:
+                return node
+        return None
+
+    def _is_aot_compile_call(self, call: ast.Call) -> bool:
+        """``<chain>.compile()`` where the chain is not a resolvable
+        dotted name — ``jit(f).lower(x).compile()`` yes, ``re.compile``
+        (resolves to a real module function) no."""
+        return (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "compile"
+                and self.ctx.resolve(call.func) is None)
+
+    def _chain_root(self, expr: ast.AST) -> ast.AST:
+        while True:
+            if isinstance(expr, ast.Call):
+                expr = expr.func
+            elif isinstance(expr, ast.Attribute):
+                expr = expr.value
+            else:
+                return expr
+
+    def _build_value_tokens(self) -> None:
+        self.compiled: dict[tuple, ast.AST] = {}
+        self.donated: dict[tuple, ast.AST] = {}
+        self.queue_tokens: set[tuple] = set()
+        self.attr_class: dict[tuple, ast.ClassDef] = {}
+        assigns = [n for n in ast.walk(self.ctx.tree)
+                   if isinstance(n, (ast.Assign, ast.AnnAssign))
+                   and (n.value is not None)]
+        assigns.sort(key=lambda n: n.lineno)   # one-pass local propagation
+        for node in assigns:
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            value = node.value
+            toks = [t for t in (self.value_token(t, node) for t in targets)
+                    if t is not None]
+            if not toks:
+                continue
+            # instance tracking: self._q = LocalClass(...)
+            if isinstance(value, ast.Call) and \
+                    isinstance(value.func, ast.Name) and \
+                    value.func.id in self.classes_by_name:
+                for tok in toks:
+                    self.attr_class[tok] = \
+                        self.classes_by_name[value.func.id]
+            # queue tracking
+            if isinstance(value, ast.Call):
+                ctor = self.ctx.resolve(value.func)
+                local_cls = (value.func.id if isinstance(value.func, ast.Name)
+                             else None)
+                if ctor in _QUEUE_CTORS or (
+                        local_cls is not None and "Queue" in local_cls):
+                    self.queue_tokens.update(toks)
+            # compiled-program tracking (with donation-ness)
+            jit = self._jit_call_in(value)
+            produces = jit is not None or (
+                isinstance(value, ast.Call)
+                and self._is_aot_compile_call(value))
+            donated = jit is not None and any(
+                kw.arg in _DONATE_KW for kw in jit.keywords)
+            if produces and jit is None:
+                # an AOT chain rooted at a tracked jit result inherits
+                # its donation-ness: jitted.lower(args).compile()
+                root = self._chain_root(value)
+                rtok = self.value_token(root, node)
+                donated = rtok in self.donated
+            elif not produces:
+                # one hop of indirection: a chain rooted at an already
+                # tracked compiled token inherits compiled/donated-ness
+                root = self._chain_root(value)
+                rtok = self.value_token(root, node)
+                if rtok in self.compiled and root is not value:
+                    produces = True
+                    donated = rtok in self.donated
+            if produces:
+                site = jit if jit is not None else value
+                for tok in toks:
+                    self.compiled[tok] = site
+                    if donated:
+                        self.donated[tok] = site
+
+    # -- thread roots -------------------------------------------------------
+    def _callable_targets(self, expr: ast.AST,
+                          near: ast.AST) -> list[ast.AST]:
+        if isinstance(expr, ast.Lambda):
+            return [expr]
+        if isinstance(expr, ast.Name):
+            return list(self.ctx.functions_by_name.get(expr.id, ()))
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            fn = self.ctx.enclosing_function(near)
+            cls = self.class_of(fn) if fn is not None else None
+            if cls is not None:
+                return list(self._methods.get((id(cls), expr.attr), ()))
+        return []
+
+    def _build_roots(self) -> None:
+        self.thread_roots: dict[ast.AST, str] = {}
+        ctx = self.ctx
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve_call(node)
+            if name in _THREAD_CTORS:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        for fn in self._callable_targets(kw.value, node):
+                            self.thread_roots.setdefault(
+                                fn, f"thread target "
+                                    f"{_outer_name(fn)!r}")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "submit" and node.args:
+                for fn in self._callable_targets(node.args[0], node):
+                    self.thread_roots.setdefault(
+                        fn, f"executor-submitted {_outer_name(fn)!r}")
+        # dispatcher/actor loop convention — armed only when the module
+        # visibly does threading (module docstring)
+        if any(a in _CONVENTION_GATE or a.startswith("concurrent.")
+               or a.startswith("threading")
+               for a in ctx.aliases.values()):
+            for fns in ctx.functions_by_name.values():
+                for fn in fns:
+                    n = fn.name
+                    if n == "loop" or n.endswith("_loop") \
+                            or n.endswith("_worker"):
+                        self.thread_roots.setdefault(
+                            fn, f"dispatcher/actor loop {n!r}")
+
+    # -- call graph + reachability ------------------------------------------
+    def _build_edges(self) -> None:
+        # callee -> [(caller_fn_or_None, call_node)]
+        self.call_sites: dict[ast.AST, list[tuple]] = {}
+        self._out_edges: dict[ast.AST, list[tuple]] = {}
+        ctx = self.ctx
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            caller = ctx.enclosing_function(call)
+            callees: list[ast.AST] = []
+            f = call.func
+            if isinstance(f, ast.Name):
+                callees = list(ctx.functions_by_name.get(f.id, ()))
+            elif isinstance(f, ast.Attribute):
+                recv = f.value
+                if isinstance(recv, ast.Name) and recv.id == "self":
+                    cls = self.class_of(caller) if caller is not None \
+                        else None
+                    if cls is not None:
+                        callees = list(self._methods.get(
+                            (id(cls), f.attr), ()))
+                else:
+                    # one hop through a tracked instance attribute/var
+                    rtok = self.value_token(recv, call)
+                    cls = self.attr_class.get(rtok) if rtok else None
+                    if cls is not None:
+                        callees = list(self._methods.get(
+                            (id(cls), f.attr), ()))
+            for callee in callees:
+                self.call_sites.setdefault(callee, []).append(
+                    (caller, call))
+                self._out_edges.setdefault(caller, []).append(
+                    (callee, call))
+
+    def _build_reach(self) -> None:
+        self.reach: dict[ast.AST, set[ast.AST]] = {}
+        for root in self.thread_roots:
+            stack, seen = [root], {root}
+            while stack:
+                fn = stack.pop()
+                self.reach.setdefault(fn, set()).add(root)
+                for callee, _ in self._out_edges.get(fn, ()):
+                    if callee not in seen:
+                        seen.add(callee)
+                        stack.append(callee)
+
+    def roots_reaching(self, node: ast.AST) -> set[ast.AST]:
+        fn = node if isinstance(node, _FuncNode) \
+            else self.ctx.enclosing_function(node)
+        return self.reach.get(fn, set()) if fn is not None else set()
+
+    def root_labels(self, roots) -> list[str]:
+        return sorted(self.thread_roots.get(r, MAIN) if r is not MAIN
+                      else MAIN for r in roots)
+
+    def _build_effective_locks(self) -> None:
+        """Fixpoint: a function's effective locks are the intersection,
+        over every call site, of the locks held there plus the caller's
+        own effective locks. Entry points (thread roots, functions with
+        no in-module callers) start with none held."""
+        fns = [n for n in ast.walk(self.ctx.tree)
+               if isinstance(n, _FuncNode)]
+        eff: dict[ast.AST, frozenset | None] = {}
+        for fn in fns:
+            if fn in self.thread_roots or not self.call_sites.get(fn):
+                eff[fn] = frozenset()
+            else:
+                eff[fn] = None   # unknown yet (TOP)
+        for _ in range(len(fns) + 1):
+            changed = False
+            for fn in fns:
+                if fn in self.thread_roots:
+                    continue
+                sites = self.call_sites.get(fn)
+                if not sites:
+                    continue
+                acc: frozenset | None = None
+                for caller, call in sites:
+                    caller_eff = (eff.get(caller) if caller is not None
+                                  else frozenset())
+                    if caller_eff is None:
+                        continue      # cycle member: no constraint yet
+                    here = self.held_at(call) | caller_eff
+                    acc = here if acc is None else (acc & here)
+                if acc is not None and acc != eff[fn]:
+                    eff[fn] = acc
+                    changed = True
+            if not changed:
+                break
+        self.effective_locks: dict[ast.AST, frozenset] = {
+            fn: (v if v is not None else frozenset())
+            for fn, v in eff.items()}
+
+
+def model_for(ctx: ModuleContext) -> ConcurrencyModel:
+    """The module's (memoized) concurrency model — every rule in one
+    analyze_file pass shares a single build."""
+    model = getattr(ctx, "_jsan_concurrency", None)
+    if model is None:
+        model = ConcurrencyModel(ctx)
+        ctx._jsan_concurrency = model
+    return model

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import json
 import os
 import re
@@ -70,6 +71,14 @@ class Finding:
     @property
     def baseline_key(self) -> tuple[str, str, str]:
         return (self.rule, self.path, self.snippet)
+
+    @property
+    def finding_id(self) -> str:
+        """Stable ID ``<rule>@<path>@<hash>``: the snippet hash makes it
+        line-drift-proof (same identity the baseline uses), short enough
+        to paste into a bug report or a CI annotation."""
+        digest = hashlib.sha1(self.snippet.encode("utf-8")).hexdigest()[:8]
+        return f"{self.rule}@{self.path}@{digest}"
 
 
 class SourceFile:

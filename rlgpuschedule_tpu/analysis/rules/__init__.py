@@ -17,12 +17,18 @@ class Rule:
 
 
 def all_rules() -> list[Rule]:
-    from . import (donation, host_sync, impure_in_jit, prng_reuse,
-                   recompile, sync_in_loop, tracer_leak,
+    from . import (blocking_under_lock, compile_off_thread,
+                   device_dispatch_unlocked, donation,
+                   donation_cross_thread, host_sync, impure_in_jit,
+                   prng_reuse, recompile, refusal_drift,
+                   shared_state_unlocked, sync_in_loop, tracer_leak,
                    unconstrained_intermediate)
     return [donation.RULE, host_sync.RULE, sync_in_loop.RULE,
             tracer_leak.RULE, impure_in_jit.RULE, recompile.RULE,
-            prng_reuse.RULE, unconstrained_intermediate.RULE]
+            prng_reuse.RULE, unconstrained_intermediate.RULE,
+            compile_off_thread.RULE, device_dispatch_unlocked.RULE,
+            donation_cross_thread.RULE, shared_state_unlocked.RULE,
+            blocking_under_lock.RULE, refusal_drift.RULE]
 
 
 def rule_names() -> list[str]:

@@ -1,0 +1,91 @@
+"""blocking-under-lock: unbounded blocking calls inside a lock region.
+
+The classic two-party deadlock: thread A holds the dispatch lock and
+blocks on ``queue.get()``; thread B must take the same lock to ``put``
+the item A is waiting for. Nobody crashes — the engine just stops, and
+on a CI rig that reads as a timeout with no stack. The serving stack's
+discipline is the model: ``PolicyServer.pump`` drains its queue under
+``self._lock`` but always waits on the Condition (which RELEASES the
+lock) or with a bounded timeout, and joins its dispatcher threads
+outside the lock.
+
+Fires on these calls when any recognized lock is held at the site
+(lexically or via the caller-side fixpoint):
+
+- ``<queue>.get(...)`` / ``<queue>.put(...)`` on a tracked queue
+  object, unless ``block=False`` or an explicit ``timeout=`` bounds it
+  (``get_nowait``/``put_nowait`` are different attributes and never
+  match);
+- ``<future>.result()`` with no timeout;
+- ``<thread>.join()`` with no arguments (``sep.join(parts)`` has an
+  argument and never matches; ``join(timeout=...)`` is bounded).
+
+``Condition.wait`` is exempt by construction — it releases the lock it
+waits on; that is the sanctioned way to block inside a region.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+from ..concurrency import model_for
+from ..engine import Finding, ModuleContext, SourceFile
+
+
+def _kw(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    model = model_for(ctx)
+    if not model.lock_tokens:
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        attr = node.func.attr
+        if attr not in ("get", "put", "result", "join"):
+            continue
+        held = model.locks_at(node)
+        if not held:
+            continue
+        what = None
+        if attr in ("get", "put"):
+            tok = model.value_token(node.func.value, node)
+            if tok is None or tok not in model.queue_tokens:
+                continue
+            block = _kw(node, "block")
+            if isinstance(block, ast.Constant) and block.value is False:
+                continue
+            if _kw(node, "timeout") is not None:
+                continue                      # bounded wait
+            what = f"blocking queue .{attr}()"
+        elif attr == "result":
+            if node.args or _kw(node, "timeout") is not None:
+                continue
+            what = "future .result() with no timeout"
+        elif attr == "join":
+            if node.args or _kw(node, "timeout") is not None:
+                continue
+            what = ".join() with no timeout"
+        locks = ", ".join(sorted(model.lock_name(t) for t in held))
+        findings.append(src.finding(
+            node, RULE.name,
+            f"{what} while holding {locks}: the thread that would "
+            f"unblock this call may need the same lock (deadlock "
+            f"hazard) — move the wait outside the region, bound it "
+            f"with a timeout, or wait on a Condition that releases "
+            f"the lock"))
+    return findings
+
+
+RULE = Rule(
+    name="blocking-under-lock",
+    summary="unbounded queue get/put, future.result(), or join() "
+            "inside a held lock region",
+    check=_check)

@@ -1,0 +1,61 @@
+"""compile-off-thread: jit compilation reachable from a thread root.
+
+The PR-8 postmortem's first XLA:CPU crash class: ``jax.jit`` tracing /
+compiling on a non-main thread corrupts the compile cache (and with
+donation in the program, the heap — observed as checkpoint poison and
+interpreter segfaults, not as a Python exception). The contract every
+threaded engine in this repo follows is AOT-at-construction:
+``jax.jit(f).lower(args).compile()`` on the construction (main) thread,
+with the thread bodies calling the execute-only Compiled objects
+(``async_engine.AsyncRunner.__init__`` builds ``self._rollout`` /
+``self._learn`` exactly this way).
+
+Fires on any ``jax.jit(...)`` / ``jax.pmap(...)`` call, or any
+``<chain>.compile()`` AOT chain, whose enclosing function is reachable
+from a thread entry point (:mod:`..concurrency`). Construction-time
+compiles (``__init__``, module level, main-path helpers) are untouched.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+from ..concurrency import model_for
+from ..engine import Finding, ModuleContext, SourceFile
+
+_JIT_CTORS = {"jax.jit", "jax.pmap"}
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    model = model_for(ctx)
+    if not model.thread_roots:
+        return []
+    findings: list[Finding] = []
+    seen_lines: set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        is_jit = ctx.resolve_call(node) in _JIT_CTORS
+        if not is_jit and not model._is_aot_compile_call(node):
+            continue
+        roots = model.roots_reaching(node)
+        if not roots or node.lineno in seen_lines:
+            continue
+        seen_lines.add(node.lineno)
+        labels = ", ".join(model.thread_roots[r] for r in sorted(
+            roots, key=lambda f: f.lineno))
+        findings.append(src.finding(
+            node, RULE.name,
+            f"jit compilation reachable from {labels}: XLA:CPU compile "
+            f"off the main thread corrupts the compile cache (PR-8 "
+            f"crash class) — AOT-compile at construction "
+            f"(jit(f).lower(args).compile()) and call the Compiled "
+            f"object from the thread"))
+    return findings
+
+
+RULE = Rule(
+    name="compile-off-thread",
+    summary="jit/AOT compilation reachable from a thread entry point "
+            "(must compile at construction, execute-only in threads)",
+    check=_check)

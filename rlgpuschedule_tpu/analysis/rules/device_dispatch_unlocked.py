@@ -1,0 +1,71 @@
+"""device-dispatch-unlocked: thread-side device work without a lock.
+
+The PR-13 postmortem class: XLA:CPU's client is not thread-safe, so
+every device interaction from a non-main thread — executing a compiled
+program, ``jax.device_put`` / ``jax.device_get`` transfers,
+``jax.block_until_ready`` — must be serialized behind a dispatch lock.
+The repo's idiom is a conditional lock that only costs anything on the
+unsafe backend::
+
+    self._dispatch_lock = (threading.Lock() if on_cpu
+                           else contextlib.nullcontext())
+    ...
+    with tracer.span("actor"), ..., self._dispatch_lock:
+        out = self._rollout(params, carry)
+
+Fires on dispatch calls (tracked compiled-object executions and the
+``jax.device_put/device_get/block_until_ready`` trio) whose enclosing
+function is thread-reachable with NO recognized lock held — lexically
+or via the caller-side lock fixpoint (:mod:`..concurrency`). Which lock
+is not checked (device identity is runtime knowledge); any recognized
+lock region satisfies the rule.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+from ..concurrency import model_for
+from ..engine import Finding, ModuleContext, SourceFile
+
+_DISPATCH_CALLS = {"jax.device_put", "jax.device_get",
+                   "jax.block_until_ready"}
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    model = model_for(ctx)
+    if not model.thread_roots:
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.resolve_call(node)
+        what = None
+        if name in _DISPATCH_CALLS:
+            what = name
+        else:
+            tok = model.value_token(node.func, node)
+            if tok is not None and tok in model.compiled:
+                what = f"compiled program {model.lock_name(tok)}"
+        if what is None:
+            continue
+        roots = model.roots_reaching(node)
+        if not roots or model.locks_at(node):
+            continue
+        labels = ", ".join(model.thread_roots[r] for r in sorted(
+            roots, key=lambda f: f.lineno))
+        findings.append(src.finding(
+            node, RULE.name,
+            f"{what} dispatched from {labels} with no dispatch lock "
+            f"held: XLA:CPU device access must be serialized across "
+            f"threads (PR-13 class) — wrap in the engine's dispatch "
+            f"lock (threading.Lock() if on_cpu else nullcontext())"))
+    return findings
+
+
+RULE = Rule(
+    name="device-dispatch-unlocked",
+    summary="thread-reachable device dispatch (compiled call / "
+            "device_put / device_get) outside any recognized lock",
+    check=_check)

@@ -1,0 +1,64 @@
+"""donation-cross-thread: one donated program, two executing threads.
+
+The PR-8 postmortem's second crash class: a jitted program with
+``donate_argnums`` frees its input buffers on dispatch. Two threads
+executing the SAME donated program can race the donation — the second
+dispatch consumes buffers the first already invalidated, which on
+XLA:CPU corrupts the heap (observed as int32 ``-1`` poison in
+checkpoint arrays and hard interpreter crashes, never a clean Python
+error). Locking narrows but does not close the window across backends,
+so the contract is structural: ONE executing thread per donated
+program. The async engine splits its work into ``self._rollout``
+(actor thread) and ``self._learn`` (learner/main thread) for exactly
+this reason.
+
+Fires once per tracked donated program (``jax.jit(...,
+donate_argnums=...)`` and its ``.lower().compile()`` chains) that is
+executed from two or more distinct entry points — thread roots, with
+the main thread counting as one entry when construction-path code also
+calls it.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+from ..concurrency import MAIN, model_for
+from ..engine import Finding, ModuleContext, SourceFile
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    model = model_for(ctx)
+    if not model.thread_roots or not model.donated:
+        return []
+    exec_roots: dict[tuple, set] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tok = model.value_token(node.func, node)
+        if tok is None or tok not in model.donated:
+            continue
+        roots = model.roots_reaching(node)
+        exec_roots.setdefault(tok, set()).update(roots or {MAIN})
+    findings: list[Finding] = []
+    for tok, roots in sorted(exec_roots.items(),
+                             key=lambda kv: model.donated[kv[0]].lineno):
+        if len(roots) < 2:
+            continue
+        labels = ", ".join(sorted(
+            model.thread_roots.get(r, "the main thread") for r in roots))
+        findings.append(src.finding(
+            model.donated[tok], RULE.name,
+            f"donated program {model.lock_name(tok)} is executed from "
+            f"{len(roots)} entry points ({labels}): concurrent dispatch "
+            f"races the buffer donation and corrupts the heap (PR-8 "
+            f"class) — give each thread its own compiled program or "
+            f"drop donate_argnums"))
+    return findings
+
+
+RULE = Rule(
+    name="donation-cross-thread",
+    summary="a donated (donate_argnums) program executable from >= 2 "
+            "thread entry points",
+    check=_check)

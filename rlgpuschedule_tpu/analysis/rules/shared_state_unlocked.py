@@ -1,0 +1,91 @@
+"""shared-state-unlocked: racing writers with no common lock region.
+
+The long-tail concurrency bug class behind the keep-best-checkpoint
+flake (PR 8): two threads writing the same instance attribute with no
+shared lock. Any interleaving "works" until the one that doesn't —
+a half-published failure flag, a counter that loses increments, a
+carry swapped mid-read.
+
+The check is write-centric and per-attribute: every write to a
+``self.<attr>`` outside ``__init__``-family methods is attributed to
+the thread roots that reach its method (the main thread when none do),
+with the lock set held there — lexically plus the caller-side fixpoint
+(:mod:`..concurrency`), so ``PolicyServer._shed_expired`` (only ever
+called under ``self._lock``) counts as locked, and writes under
+``self._wake`` (a ``Condition(self._lock)``) alias to the same region.
+An attribute written from two or more distinct roots whose write-site
+lock sets share NO common lock fires once, at the first write.
+
+Reads are deliberately out of scope (flagging every unlocked read of a
+monotonic gauge would bury the true positives); a read-side tear that
+matters shows up as a write somewhere else.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+from ..concurrency import MAIN, model_for
+from ..engine import Finding, ModuleContext, SourceFile
+
+_CTOR_METHODS = {"__init__", "__post_init__", "__new__", "__set_name__"}
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    model = model_for(ctx)
+    if not model.thread_roots:
+        return []
+    # (class, attr) -> list of (node, roots, locks)
+    writes: dict[tuple, list] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            fn = ctx.enclosing_function(node)
+            if fn is None or getattr(fn, "name", "") in _CTOR_METHODS:
+                continue
+            cls = model.class_of(fn)
+            if cls is None:
+                continue
+            roots = frozenset(model.roots_reaching(node)) or \
+                frozenset({MAIN})
+            writes.setdefault((id(cls), cls.name, t.attr), []).append(
+                (node, roots, model.locks_at(node)))
+    findings: list[Finding] = []
+    for (_, cls_name, attr), sites in sorted(
+            writes.items(), key=lambda kv: kv[1][0][0].lineno):
+        all_roots = frozenset().union(*(r for _, r, _ in sites))
+        if len(all_roots) < 2 or not (all_roots - {MAIN}):
+            continue
+        common = sites[0][2]
+        for _, _, locks in sites[1:]:
+            common &= locks
+        if common:
+            continue
+        first = min((n for n, _, _ in sites), key=lambda n: n.lineno)
+        labels = ", ".join(sorted(
+            model.thread_roots.get(r, "the main thread")
+            for r in all_roots))
+        findings.append(src.finding(
+            first, RULE.name,
+            f"self.{attr} ({cls_name}) is written from {len(all_roots)} "
+            f"entry points ({labels}) with no common lock across the "
+            f"writes: protect every write with one shared lock (a "
+            f"Condition wrapping it counts) or confine the attribute "
+            f"to one thread"))
+    return findings
+
+
+RULE = Rule(
+    name="shared-state-unlocked",
+    summary="an instance attribute written from >= 2 thread roots with "
+            "no common lock region",
+    check=_check)

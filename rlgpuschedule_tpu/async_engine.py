@@ -412,11 +412,14 @@ class AsyncRunner:
             self._bar_cv.notify_all()
 
     def _abort(self, exc: BaseException) -> None:
-        self._failure = exc
+        # publish the failure under the barrier Condition: the learner
+        # reads it there, and an unlocked write could be seen torn
+        # against the notify
+        with self._bar_cv:
+            self._failure = exc
+            self._bar_cv.notify_all()
         self.queue.abort(exc)
         self._slot.abort()
-        with self._bar_cv:
-            self._bar_cv.notify_all()
 
     # -- the actor loop (background thread) --------------------------------
 
@@ -505,7 +508,7 @@ class AsyncRunner:
         with self._bar_cv:
             self._barriers = [base + b for b in local_barriers]
             self._barriers_done = 0
-        self._failure = None
+            self._failure = None
 
         if telemetry is not None:
             telemetry.run_start(
@@ -891,11 +894,14 @@ class AsyncPopulationRunner:
             self._bar_cv.notify_all()
 
     def _abort(self, exc: BaseException) -> None:
-        self._failure = exc
+        # publish the failure under the barrier Condition: the learner
+        # reads it there, and an unlocked write could be seen torn
+        # against the notify
+        with self._bar_cv:
+            self._failure = exc
+            self._bar_cv.notify_all()
         self.queue.abort(exc)
         self._slot.abort()
-        with self._bar_cv:
-            self._bar_cv.notify_all()
 
     # -- the actor loop (background thread) ---------------------------------
 
@@ -980,7 +986,7 @@ class AsyncPopulationRunner:
         with self._bar_cv:
             self._barriers = [base + b for b in local_barriers]
             self._barriers_done = 0
-        self._failure = None
+            self._failure = None
 
         if telemetry is not None:
             telemetry.run_start(

@@ -255,6 +255,17 @@ def main(argv: list[str] | None = None) -> dict:
              "faults": args.faults,
              "domains": args.domains}.items() if v is not None}
     cfg = dataclasses.replace(cfg, **over)
+
+    from .configs import ModeCombinationError, validate_mode_combination
+    try:
+        validate_mode_combination({
+            "pbt": args.pbt,
+            "faults": args.faults is not None,
+            "domains": args.domains is not None,
+        })
+    except ModeCombinationError as e:
+        sys.exit(str(e))
+
     if args.source_jobs is not None:
         if args.source_jobs <= 0:
             sys.exit("--source-jobs must be positive")

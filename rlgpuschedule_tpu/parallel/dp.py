@@ -150,6 +150,17 @@ def shard_map_train(mesh: Mesh, train_step_axis: Callable, train_state,
     replicated key's draws on every shard. Metrics are pmean'd before
     leaving the shard so the host sees one replicated value, same as the
     GSPMD path."""
+    from ..configs import validate_mode_combination
+    # shard_map is a build-path mode with no CLI flag, so its refusal
+    # rows are enforced here, at the mode's activation site. The
+    # companion modes are False by construction on this path: the
+    # shard_map build is the synchronous single-policy loop (no async
+    # engine, no PBT controller), takes the whole train step (no fused
+    # chunk), and IS the explicit-collective alternative to the GSPMD
+    # --mesh build.
+    validate_mode_combination({"shard_map": True, "pbt": False,
+                               "async": False, "fused_chunk": False,
+                               "mesh": False})
     _check_env_divisible(mesh, traces)
     n_data = mesh.shape[DATA_AXIS]
 

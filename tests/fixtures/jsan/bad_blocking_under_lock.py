@@ -1,0 +1,22 @@
+"""Known-bad: unbounded blocking waits inside a held lock (3 findings)."""
+import queue
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._t = threading.Thread(target=self._drain_loop)
+
+    def _drain_loop(self):
+        with self._lock:
+            item = self._q.get()                         # finding
+            self._q.put(item)                            # finding
+
+    def stop(self):
+        with self._lock:
+            self._t.join()                               # finding
+
+    def start(self):
+        self._t.start()

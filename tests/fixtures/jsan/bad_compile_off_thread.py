@@ -1,0 +1,38 @@
+"""Known-bad: jit/AOT compilation reachable from thread roots (3 findings)."""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+
+_CACHE = {}
+
+
+def _step(x):
+    return x * 2
+
+
+def _warm(x):
+    _CACHE["step"] = jax.jit(_step)                      # finding: thread target
+    return x
+
+
+def _warm_aot(x):
+    _CACHE["aot"] = jax.jit(_step).lower(x).compile()    # finding: submitted
+    return x
+
+
+class Engine:
+    def __init__(self):
+        self._fn = None
+
+    def _actor_loop(self, x):
+        self._fn = jax.jit(_step)                        # finding: loop root
+        return x
+
+    def start(self, x):
+        t = threading.Thread(target=self._actor_loop, args=(x,))
+        t.start()
+        threading.Thread(target=_warm, args=(x,)).start()
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            ex.submit(_warm_aot, x)
+        return t

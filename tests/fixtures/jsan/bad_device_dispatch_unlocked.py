@@ -1,0 +1,23 @@
+"""Known-bad: thread-side device dispatch with no lock held (3 findings)."""
+import threading
+
+import jax
+
+
+def _step(x):
+    return x + 1
+
+
+class Engine:
+    def __init__(self, x):
+        self._fn = jax.jit(_step).lower(x).compile()
+
+    def _serve_loop(self, x):
+        on_device = jax.device_put(x)                    # finding
+        out = self._fn(on_device)                        # finding
+        return jax.device_get(out)                       # finding
+
+    def start(self, x):
+        t = threading.Thread(target=self._serve_loop, args=(x,))
+        t.start()
+        return t

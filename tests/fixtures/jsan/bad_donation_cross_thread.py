@@ -1,0 +1,35 @@
+"""Known-bad: one donated program executed from two threads (1 finding).
+
+The dispatch lock does NOT make this safe — donation is structural, one
+executing thread per donated program — so both loops lock and the rule
+still fires (and device-dispatch-unlocked stays quiet).
+"""
+import threading
+
+import jax
+
+
+def _update(state, x):
+    return state + x
+
+
+class Runner:
+    def __init__(self, state, x):
+        self._lock = threading.Lock()
+        self._step = jax.jit(                            # finding
+            _update, donate_argnums=(0,)).lower(state, x).compile()
+
+    def _a_loop(self, state, x):
+        with self._lock:
+            return self._step(state, x)
+
+    def _b_loop(self, state, x):
+        with self._lock:
+            return self._step(state, x)
+
+    def start(self, state, x):
+        ta = threading.Thread(target=self._a_loop, args=(state, x))
+        tb = threading.Thread(target=self._b_loop, args=(state, x))
+        ta.start()
+        tb.start()
+        return ta, tb

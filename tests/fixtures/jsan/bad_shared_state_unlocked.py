@@ -1,0 +1,29 @@
+"""Known-bad: shared attributes written from racing threads (2 findings)."""
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self._n = 0
+        self._err = None
+
+    def _count_loop(self):
+        with self._a_lock:
+            self._n = self._n + 1                        # finding: disjoint locks
+
+    def _drain_loop(self):
+        with self._b_lock:
+            self._n = self._n + 1
+
+    def _watch_loop(self):
+        self._err = "boom"                               # finding: races reset()
+
+    def reset(self):
+        self._err = None
+
+    def start(self):
+        threading.Thread(target=self._count_loop).start()
+        threading.Thread(target=self._drain_loop).start()
+        threading.Thread(target=self._watch_loop).start()

@@ -1,0 +1,26 @@
+"""Known-good: waits bounded by a timeout or moved outside the lock."""
+import queue
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._t = threading.Thread(target=self._drain_loop)
+
+    def _drain_loop(self):
+        with self._lock:
+            item = self._q.get(timeout=0.5)
+            self._q.put(item, block=False)
+        self._q.put(item)
+
+    def summary(self, parts):
+        with self._lock:
+            return ",".join(parts)
+
+    def stop(self):
+        self._t.join()
+
+    def start(self):
+        self._t.start()

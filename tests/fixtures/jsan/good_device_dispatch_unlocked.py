@@ -1,0 +1,29 @@
+"""Known-good: thread-side dispatch serialized behind the conditional lock."""
+import contextlib
+import threading
+
+import jax
+
+_ON_CPU = True
+
+
+def _step(x):
+    return x + 1
+
+
+class Engine:
+    def __init__(self, x):
+        self._dispatch_lock = (threading.Lock() if _ON_CPU
+                               else contextlib.nullcontext())
+        self._fn = jax.jit(_step).lower(x).compile()
+
+    def _serve_loop(self, x):
+        with self._dispatch_lock:
+            on_device = jax.device_put(x)
+            out = self._fn(on_device)
+            return jax.device_get(out)
+
+    def start(self, x):
+        t = threading.Thread(target=self._serve_loop, args=(x,))
+        t.start()
+        return t

@@ -1,0 +1,31 @@
+"""Known-good: each thread owns its own donated program."""
+import threading
+
+import jax
+
+
+def _update(state, x):
+    return state + x
+
+
+class Runner:
+    def __init__(self, state, x):
+        self._lock = threading.Lock()
+        jitted = jax.jit(_update, donate_argnums=(0,))
+        self._a_step = jitted.lower(state, x).compile()
+        self._b_step = jitted.lower(state, x).compile()
+
+    def _a_loop(self, state, x):
+        with self._lock:
+            return self._a_step(state, x)
+
+    def _b_loop(self, state, x):
+        with self._lock:
+            return self._b_step(state, x)
+
+    def start(self, state, x):
+        ta = threading.Thread(target=self._a_loop, args=(state, x))
+        tb = threading.Thread(target=self._b_loop, args=(state, x))
+        ta.start()
+        tb.start()
+        return ta, tb

@@ -1,0 +1,33 @@
+"""Known-good: every cross-thread write shares one lock region (the
+Condition wraps the same lock, so holding either holds the region)."""
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._n = 0
+        self._err = None
+
+    def _count_loop(self):
+        with self._lock:
+            self._n = self._n + 1
+
+    def _drain_loop(self):
+        with self._wake:
+            self._n = self._n + 1
+            self._wake.notify_all()
+
+    def _watch_loop(self):
+        with self._lock:
+            self._err = "boom"
+
+    def reset(self):
+        with self._lock:
+            self._err = None
+
+    def start(self):
+        threading.Thread(target=self._count_loop).start()
+        threading.Thread(target=self._drain_loop).start()
+        threading.Thread(target=self._watch_loop).start()

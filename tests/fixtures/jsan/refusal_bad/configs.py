@@ -1,0 +1,31 @@
+"""Known-bad defining module: a refusal row nobody guards (1 finding)."""
+
+
+class ModeCombinationError(ValueError):
+    pass
+
+
+MODE_FLAGS = {
+    "async": "--async",
+    "pbt": "--pbt",
+    "mesh": "--mesh",
+    "sync": "the synchronous loop (no --async)",
+}
+
+MODE_REFUSALS = (
+    ("async", "pbt",
+     "the async engine owns the population schedule"),
+    ("pbt", "mesh",                                      # finding: unguarded row
+     "no guard anywhere in this tree references the pair"),
+)
+
+
+def validate_mode_combination(active):
+    for a, b, why in MODE_REFUSALS:
+        if a not in active or b not in active:
+            continue
+        if active[a] and active[b]:
+            raise ModeCombinationError(why)
+    for key in active:
+        if key not in MODE_FLAGS:
+            raise KeyError(key)

@@ -1,0 +1,9 @@
+"""Known-bad caller: exposes a refusable flag pair, no guard (1 finding)."""
+import argparse
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--pbt", action="store_true")         # finding anchors here
+    p.add_argument("--mesh", default="off")
+    return p.parse_args(argv)

@@ -1,0 +1,18 @@
+"""Known-bad caller: unknown guard key + ad-hoc refusal (2 findings)."""
+import argparse
+
+from configs import ModeCombinationError, validate_mode_combination
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--async", dest="async_run", action="store_true")
+    p.add_argument("--pbt", action="store_true")
+    args = p.parse_args(argv)
+    validate_mode_combination({"async": args.async_run,  # finding: "delta"
+                               "pbt": args.pbt,
+                               "delta": False})
+    if args.pbt and args.async_run:
+        raise ModeCombinationError(                      # finding: ad-hoc
+            "pbt is incompatible with async")
+    return args

@@ -1,0 +1,28 @@
+"""Known-good defining module: every refusal row has a guard in train.py."""
+
+
+class ModeCombinationError(ValueError):
+    pass
+
+
+MODE_FLAGS = {
+    "async": "--async",
+    "pbt": "--pbt",
+    "mesh": "--mesh",
+}
+
+MODE_REFUSALS = (
+    ("async", "pbt",
+     "the async engine owns the population schedule"),
+    ("pbt", "mesh",
+     "the PBT controller assumes the plain unsharded build"),
+)
+
+
+def validate_mode_combination(active):
+    for key in active:
+        if key not in MODE_FLAGS:
+            raise KeyError(key)
+    for a, b, why in MODE_REFUSALS:
+        if active.get(a) and active.get(b):
+            raise ModeCombinationError(why)

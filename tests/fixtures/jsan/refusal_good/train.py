@@ -1,0 +1,20 @@
+"""Known-good caller: one guard whose keys cover every refused pair."""
+import argparse
+import sys
+
+from configs import ModeCombinationError, validate_mode_combination
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--async", dest="async_run", action="store_true")
+    p.add_argument("--pbt", action="store_true")
+    p.add_argument("--mesh", default="off")
+    args = p.parse_args(argv)
+    try:
+        validate_mode_combination({"async": args.async_run,
+                                   "pbt": args.pbt,
+                                   "mesh": args.mesh != "off"})
+    except ModeCombinationError as e:
+        sys.exit(str(e))
+    return args

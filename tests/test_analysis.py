@@ -1,7 +1,10 @@
-"""jsan static-analyzer tests (PR 3): one known-good + known-bad fixture
-pair per rule, suppression + baseline workflows, JSON output stability,
-and the two acceptance gates — the shipped tree is clean, and seeding
-any known-bad snippet into a tree makes the CLI exit nonzero.
+"""jsan static-analyzer tests (PR 3, extended by PR 15): one known-good
++ known-bad fixture pair per rule, the thread-aware concurrency rules
+and the refusal-matrix drift checker, suppression + baseline workflows
+(including --prune-baseline / --fail-stale), JSON + SARIF output,
+--diff / --explain, the exit-code contract, and the acceptance gates —
+the shipped tree is clean with an EMPTY baseline, and seeding any
+known-bad snippet into a tree makes the CLI exit nonzero.
 """
 import json
 import os
@@ -30,11 +33,24 @@ BAD = {
     "sync-in-loop": ("bad_sync_in_loop.py", 3),
     "unconstrained-intermediate":
         ("bad_unconstrained_intermediate.py", 2),
+    "compile-off-thread": ("bad_compile_off_thread.py", 3),
+    "device-dispatch-unlocked": ("bad_device_dispatch_unlocked.py", 3),
+    "donation-cross-thread": ("bad_donation_cross_thread.py", 1),
+    "shared-state-unlocked": ("bad_shared_state_unlocked.py", 2),
+    "blocking-under-lock": ("bad_blocking_under_lock.py", 3),
+    "refusal-drift": (os.path.join("refusal_bad", "train.py"), 2),
 }
 GOOD = ["good_donation.py", "good_host_sync.py", "good_tracer_leak.py",
         "good_impure.py", "good_recompile.py", "good_prng_reuse.py",
         "good_sync_in_loop.py",
-        "good_unconstrained_intermediate.py"]
+        "good_unconstrained_intermediate.py",
+        "good_compile_off_thread.py",
+        "good_device_dispatch_unlocked.py",
+        "good_donation_cross_thread.py",
+        "good_shared_state_unlocked.py",
+        "good_blocking_under_lock.py",
+        os.path.join("refusal_good", "configs.py"),
+        os.path.join("refusal_good", "train.py")]
 
 
 def _cli(*args, cwd=REPO):
@@ -212,3 +228,239 @@ class TestRepoBaselineFile:
         stale = [e for e in data["entries"]
                  if (e["rule"], e["path"], e["snippet"]) not in current]
         assert stale == [], f"stale baseline entries: {stale}"
+
+    def test_shipped_tree_has_zero_findings_without_baseline(self):
+        """PR-15 acceptance: the full package is clean on its own —
+        the committed baseline is EMPTY, nothing is grandfathered."""
+        findings = analyze_paths(
+            [os.path.join(REPO, "rlgpuschedule_tpu"),
+             os.path.join(REPO, "bench.py"),
+             os.path.join(REPO, "__graft_entry__.py")])
+        assert findings == [], [f"{f.path}:{f.line} [{f.rule}]"
+                                for f in findings]
+        with open(os.path.join(REPO, "jsan_baseline.json")) as f:
+            assert json.load(f)["entries"] == []
+
+
+class TestConcurrencyRules:
+    """Workflow round-trips for the thread-aware rules (the per-rule
+    counts live in BAD/GOOD above)."""
+
+    def test_inline_suppression_silences_concurrency_finding(self,
+                                                             tmp_path):
+        bad = open(os.path.join(
+            FIXTURES, "bad_blocking_under_lock.py")).read()
+        patched = bad.replace(
+            "item = self._q.get()",
+            "item = self._q.get()  "
+            "# jsan: disable=blocking-under-lock -- test")
+        p = tmp_path / "patched.py"
+        p.write_text(patched)
+        findings = analyze_paths([str(p)])
+        assert len(findings) == BAD["blocking-under-lock"][1] - 1
+        assert {f.rule for f in findings} == {"blocking-under-lock"}
+
+    def test_baseline_survives_line_drift_for_concurrency_rule(
+            self, tmp_path):
+        src = open(os.path.join(
+            FIXTURES, "bad_shared_state_unlocked.py")).read()
+        p = tmp_path / "mod.py"
+        p.write_text(src)
+        findings = analyze_paths([str(p)])
+        assert findings
+        baseline = {f.baseline_key for f in findings}
+        p.write_text("# pushed\n# down\n" + src)
+        drifted = analyze_paths([str(p)])
+        assert apply_baseline(drifted, baseline) == []
+
+    def test_condition_alias_counts_as_the_wrapped_lock(self, tmp_path):
+        """Dropping the Condition's wrapped-lock argument decouples the
+        two regions and the good shared-state fixture goes bad — the
+        alias recognition is load-bearing, not decorative."""
+        src = open(os.path.join(
+            FIXTURES, "good_shared_state_unlocked.py")).read()
+        p = tmp_path / "mod.py"
+        p.write_text(src.replace("threading.Condition(self._lock)",
+                                 "threading.Condition()"))
+        findings = analyze_paths([str(p)])
+        assert [f.rule for f in findings] == ["shared-state-unlocked"]
+
+
+class TestRefusalDrift:
+    @pytest.mark.parametrize("fname,count,needle", [
+        (os.path.join("refusal_bad", "configs.py"), 1,
+         "no reachable guard"),
+        (os.path.join("refusal_bad", "train.py"), 2, "delta"),
+        (os.path.join("refusal_bad", "evaluate.py"), 1,
+         "refused pair"),
+    ])
+    def test_bad_fixture_counts_and_messages(self, fname, count, needle):
+        findings = analyze_paths([os.path.join(FIXTURES, fname)])
+        assert len(findings) == count, findings
+        assert {f.rule for f in findings} == {"refusal-drift"}
+        assert any(needle in f.message for f in findings), findings
+
+    def test_adhoc_raise_is_flagged(self):
+        findings = analyze_paths(
+            [os.path.join(FIXTURES, "refusal_bad", "train.py")])
+        assert any("ad-hoc" in f.message for f in findings)
+
+    def test_real_table_rows_are_all_guarded(self):
+        """The shipped MODE_REFUSALS table has a guard for every row
+        (this is what the PR-15 production fixes bought)."""
+        findings = analyze_paths(
+            [os.path.join(REPO, "rlgpuschedule_tpu", "configs.py")])
+        assert [f for f in findings if f.rule == "refusal-drift"] == []
+
+
+class TestSarif:
+    def test_sarif_output_is_schema_shaped(self):
+        fname, expected = BAD["blocking-under-lock"]
+        r = _cli(os.path.join(FIXTURES, fname), "--format", "sarif",
+                 "--no-baseline")
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)
+        assert doc["version"] == "2.1.0"
+        assert "sarif-schema-2.1.0" in doc["$schema"]
+        assert len(doc["runs"]) == 1
+        driver = doc["runs"][0]["tool"]["driver"]
+        assert driver["name"] == "jsan"
+        assert {r_["id"] for r_ in driver["rules"]} == set(rule_names())
+        assert all(r_["shortDescription"]["text"] for r_ in driver["rules"])
+        results = doc["runs"][0]["results"]
+        assert len(results) == expected
+        for res in results:
+            assert res["ruleId"] in set(rule_names())
+            assert res["message"]["text"]
+            assert res["partialFingerprints"]["jsanFindingId/v1"]
+            loc = res["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"].endswith(".py")
+            assert loc["region"]["startLine"] >= 1
+            assert loc["region"]["startColumn"] >= 1
+
+    def test_sarif_clean_tree_has_empty_results(self, tmp_path):
+        p = tmp_path / "clean.py"
+        p.write_text("X = 1\n")
+        r = _cli(str(p), "--format", "sarif", cwd=str(tmp_path))
+        assert r.returncode == 0
+        assert json.loads(r.stdout)["runs"][0]["results"] == []
+
+
+class TestDiff:
+    def _git(self, cwd, *args):
+        return subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+            cwd=cwd, capture_output=True, text=True, check=True)
+
+    def test_diff_restricts_to_changed_files(self, tmp_path):
+        self._git(tmp_path, "init", "-q")
+        a = tmp_path / "a.py"
+        b = tmp_path / "b.py"
+        bad = open(os.path.join(FIXTURES, "bad_prng_reuse.py")).read()
+        a.write_text("X = 1\n")
+        b.write_text(bad)
+        self._git(tmp_path, "add", "-A")
+        self._git(tmp_path, "commit", "-qm", "seed")
+        a.write_text(bad)                    # a changes, b does not
+        r = _cli(".", "--diff", "HEAD", "--no-baseline",
+                 cwd=str(tmp_path))
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "a.py" in r.stdout
+        assert "b.py" not in r.stdout
+
+    def test_diff_with_no_changes_exits_clean(self, tmp_path):
+        self._git(tmp_path, "init", "-q")
+        (tmp_path / "a.py").write_text("X = 1\n")
+        self._git(tmp_path, "add", "-A")
+        self._git(tmp_path, "commit", "-qm", "seed")
+        r = _cli(".", "--diff", "HEAD", cwd=str(tmp_path))
+        assert r.returncode == 0
+        assert "no analyzable files changed" in r.stdout
+
+    def test_diff_bad_rev_is_invocation_error(self, tmp_path):
+        self._git(tmp_path, "init", "-q")
+        (tmp_path / "a.py").write_text("X = 1\n")
+        r = _cli(".", "--diff", "no-such-rev", cwd=str(tmp_path))
+        assert r.returncode == 2
+        assert "git diff" in r.stderr
+
+
+class TestExplain:
+    def test_explain_prints_rule_rationale(self):
+        r = _cli("--explain", "refusal-drift")
+        assert r.returncode == 0
+        assert "MODE_REFUSALS" in r.stdout
+        r = _cli("--explain", "compile-off-thread")
+        assert r.returncode == 0
+        assert "PR-8" in r.stdout or "compile" in r.stdout
+
+    def test_explain_unknown_rule_is_invocation_error(self):
+        r = _cli("--explain", "no-such-rule")
+        assert r.returncode == 2
+        assert "unknown rule" in r.stderr
+
+
+class TestExitCodeContract:
+    def test_findings_exit_1_with_stable_ids(self):
+        fname, _ = BAD["shared-state-unlocked"]
+        r = _cli(os.path.join(FIXTURES, fname), "--no-baseline")
+        assert r.returncode == 1
+        assert "id: shared-state-unlocked@" in r.stdout
+        r2 = _cli(os.path.join(FIXTURES, fname), "--no-baseline")
+        assert r.stdout == r2.stdout       # IDs are deterministic
+
+    def test_unparsable_input_exits_2(self, tmp_path):
+        p = tmp_path / "nul.py"
+        p.write_bytes(b"x = 1\x00\n")       # ast.parse raises ValueError
+        r = _cli(str(p), cwd=str(tmp_path))
+        assert r.returncode == 2
+        assert "internal error" in r.stderr or "cannot parse" in r.stderr
+
+    def test_missing_path_exits_2(self):
+        r = _cli("definitely/not/a/path.py")
+        assert r.returncode == 2
+        assert "no such path" in r.stderr
+
+
+class TestBaselineMaintenance:
+    def test_fail_stale_flags_dead_entries(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(open(os.path.join(
+            FIXTURES, "bad_recompile.py")).read())
+        base = tmp_path / "baseline.json"
+        r = _cli("bad.py", "--write-baseline", "baseline.json",
+                 cwd=str(tmp_path))
+        assert r.returncode == 0
+        # with live entries, --fail-stale is quiet
+        r = _cli("bad.py", "--baseline", "baseline.json", "--fail-stale",
+                 cwd=str(tmp_path))
+        assert r.returncode == 0, r.stdout + r.stderr
+        # fix the file: the baseline entries go stale and the gate trips
+        bad.write_text("X = 1\n")
+        r = _cli("bad.py", "--baseline", "baseline.json", "--fail-stale",
+                 cwd=str(tmp_path))
+        assert r.returncode == 1
+        assert "stale baseline entry" in r.stderr
+        assert base.exists()
+
+    def test_prune_baseline_drops_only_stale_entries(self, tmp_path):
+        (tmp_path / "bad.py").write_text(open(os.path.join(
+            FIXTURES, "bad_recompile.py")).read())
+        (tmp_path / "bad2.py").write_text(open(os.path.join(
+            FIXTURES, "bad_prng_reuse.py")).read())
+        r = _cli("bad.py", "bad2.py", "--write-baseline",
+                 "baseline.json", cwd=str(tmp_path))
+        assert r.returncode == 0
+        (tmp_path / "bad2.py").write_text("X = 1\n")   # half goes stale
+        r = _cli("bad.py", "bad2.py", "--baseline", "baseline.json",
+                 "--prune-baseline", cwd=str(tmp_path))
+        assert r.returncode == 0
+        assert "pruned" in r.stdout
+        entries = json.loads(
+            (tmp_path / "baseline.json").read_text())["entries"]
+        assert entries                         # live entries kept
+        assert all(e["path"] == "bad.py" for e in entries)
+        # after the prune the gate is quiet again
+        r = _cli("bad.py", "bad2.py", "--baseline", "baseline.json",
+                 "--fail-stale", cwd=str(tmp_path))
+        assert r.returncode == 0, r.stdout + r.stderr
