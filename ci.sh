@@ -96,13 +96,16 @@ ASYNC_OBS_DIR=$(mktemp -d /tmp/ci_async_obs.XXXXXX)
 VTRACE_OBS_DIR=$(mktemp -d /tmp/ci_vtrace_obs.XXXXXX)
 SERVE_OBS_DIR=$(mktemp -d /tmp/ci_serve_obs.XXXXXX)
 SOAK_OBS_DIR=$(mktemp -d /tmp/ci_soak_obs.XXXXXX)
+CHAOS_SOAK_OBS_DIR=$(mktemp -d /tmp/ci_chaos_soak_obs.XXXXXX)
 CHAOS_JSON=$(mktemp /tmp/ci_chaos.XXXXXX.json)
 SERVE_JSON=$(mktemp /tmp/ci_serve.XXXXXX.json)
 SOAK_JSON=$(mktemp /tmp/ci_soak.XXXXXX.json)
+CHAOS_SOAK_JSON=$(mktemp /tmp/ci_chaos_soak.XXXXXX.json)
 TRACE_JSON=$(mktemp /tmp/ci_trace.XXXXXX.json)
 trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$VTRACE_OBS_DIR" \
-    "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON" \
-    "$SOAK_JSON" "$TRACE_JSON"' EXIT
+    "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_SOAK_OBS_DIR" \
+    "$CHAOS_JSON" "$SERVE_JSON" "$SOAK_JSON" "$CHAOS_SOAK_JSON" \
+    "$TRACE_JSON"' EXIT
 # --trace-spans rides along (ISSUE 11): the flight recorder must not
 # disturb the strict-alarms gate, and the exported Chrome trace must be
 # Perfetto-valid (validated per layer below)
@@ -258,8 +261,9 @@ MATRIX_CKPT_DIR=$(mktemp -d /tmp/ci_matrix_ckpt.XXXXXX)
 MATRIX_CLEAN_DIR=$(mktemp -d /tmp/ci_matrix_clean.XXXXXX)
 MATRIX_JSON=$(mktemp /tmp/ci_matrix.XXXXXX.json)
 trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$VTRACE_OBS_DIR" \
-    "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON" \
-    "$SOAK_JSON" "$TRACE_JSON" \
+    "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_SOAK_OBS_DIR" \
+    "$CHAOS_JSON" "$SERVE_JSON" "$SOAK_JSON" "$CHAOS_SOAK_JSON" \
+    "$TRACE_JSON" \
     "$MATRIX_OBS_DIR" "$MATRIX_CKPT_DIR" "$MATRIX_CLEAN_DIR" \
     "$MATRIX_JSON"' EXIT
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
@@ -413,6 +417,81 @@ print("soak-lite smoke ok:", {
     "per_engine_rows": s["per_engine_rows"]})
 EOF
 
+echo "=== smoke: chaos-soak (engine faults mid-run, HTTP front door, 2 CPU devices) ==="
+# ISSUE 16 acceptance: the same routed soak with a seeded fault
+# injector killing engine 0 mid-run (two consecutive raises -> eject,
+# backoff, blessed re-warm, readmit) and the HTTP front door wrapped
+# around the server. The run must hold EXACT conservation
+# (submitted == served + shed + failed with failed == 0 — the retry
+# hedge absorbs every injected fault), count sheds exactly once
+# (registry counter == shed futures observed), keep zero post-warmup
+# recompiles per engine, bound the p99 drift, land the full
+# eject/readmit/retry lifecycle on the event bus, and prove the drain
+# contract on the wire (late submit -> typed refusal, connect refused).
+# NOTE: no --autoscale — the chaos soak does not drive the advisor
+# loop, and the CLI refuses the combination outright.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python -m rlgpuschedule_tpu.serve --config ppo-mlp-synth64 \
+    --engines 2 --soak 6 --rate 150 --deadline-ms 250 \
+    --adaptive-wait --bucket 8 --pool-steps 2 \
+    --n-envs 2 --n-nodes 2 --gpus-per-node 4 --window-jobs 16 \
+    --queue-len 4 --horizon 64 \
+    --chaos-faults "engine-raise@40:engine=0,engine-raise@40:engine=0" \
+    --frontend-port 0 \
+    --obs-dir "$CHAOS_SOAK_OBS_DIR" --trace-spans \
+    --metrics-port 0 > "$CHAOS_SOAK_JSON"
+timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.obs.report "$CHAOS_SOAK_OBS_DIR" \
+    --strict-alarms --trace-out "$TRACE_JSON" > /dev/null
+validate_trace "$TRACE_JSON" chaos-soak
+python - "$CHAOS_SOAK_JSON" "$CHAOS_SOAK_OBS_DIR" <<'EOF'
+import json, sys
+from rlgpuschedule_tpu.obs import merge_dir
+rep = json.load(open(sys.argv[1]))
+s = rep["soak"]
+# exact conservation: every submitted request resolved or shed, none
+# failed (the retry-once hedge absorbed both injected engine faults),
+# and the shed counter agrees with the futures actually observed
+assert s["conservation_ok"], s
+assert s["requests"] == s["served"] + s["shed"], s
+assert s["failed"] == 0, s["failure_kinds"]
+assert s["registry_shed_total"] == s["shed"], s
+assert s["faults_fired"] == 2, s["faults_fired"]
+fs = s["fault_stats"]
+assert fs["failures"] >= 2, fs
+assert fs["ejections"] >= 1, fs
+assert fs["readmissions"] >= 1, fs         # backoff elapsed in-run
+assert fs["retry_hedges"] >= 2, fs         # every fault hedged away
+assert s["per_engine_recompiles"] == [0, 0], s["per_engine_recompiles"]
+assert s["post_warmup_recompiles"] == 0, s
+drift = s["p99_drift"]
+assert drift is None or drift < 3.0, f"p99 drift {drift}"
+assert s["shed_rate"] <= 0.5, s["shed_rate"]
+# the fault lifecycle must be a readable story on the event bus
+kinds = {e["kind"] for e in merge_dir(sys.argv[2])}
+for k in ("serve_fault", "engine_eject", "engine_readmit",
+          "serve_retry"):
+    assert k in kinds, f"missing bus event {k!r}: {sorted(kinds)}"
+# wire-level drain contract, proven against the live front door
+fe = rep["frontend"]
+assert fe["decide_status"] == 200 and fe["decide_has_action"], fe
+assert fe["drained"] and fe["late_submit"] == "server-closed", fe
+assert fe["post_drain_connect"] == "refused", fe
+prom = open(sys.argv[2] + "/metrics.prom").read()
+for series in ('serve_engine_ejections_total{engine="0"}',
+               "serve_retry_hedges_total",
+               "serve_frontend_requests_total"):
+    assert series in prom, f"missing scrape series: {series}"
+print("chaos-soak smoke ok:", {
+    "requests": s["requests"], "shed": s["shed"],
+    "faults_fired": s["faults_fired"],
+    "ejections": fs["ejections"],
+    "readmissions": fs["readmissions"],
+    "retry_hedges": fs["retry_hedges"],
+    "frontend": fe["post_drain_connect"]})
+EOF
+
 echo "=== smoke: sharding (rule-mesh train + PBT-on-mesh, 2 CPU devices) ==="
 # ISSUE 10 acceptance: a rule-sharded --mesh auto run and a PBT run
 # whose population rides the unified mesh's pop axis must both pass the
@@ -424,8 +503,9 @@ PBT_OBS_DIR=$(mktemp -d /tmp/ci_pbt_obs.XXXXXX)
 MESH_JSON=$(mktemp /tmp/ci_mesh.XXXXXX.json)
 PBT_JSON=$(mktemp /tmp/ci_pbt.XXXXXX.json)
 trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$VTRACE_OBS_DIR" \
-    "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_JSON" "$SERVE_JSON" \
-    "$SOAK_JSON" "$TRACE_JSON" \
+    "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_SOAK_OBS_DIR" \
+    "$CHAOS_JSON" "$SERVE_JSON" "$SOAK_JSON" "$CHAOS_SOAK_JSON" \
+    "$TRACE_JSON" \
     "$MATRIX_OBS_DIR" "$MATRIX_CKPT_DIR" "$MATRIX_CLEAN_DIR" \
     "$MATRIX_JSON" \
     "$MESH_OBS_DIR" "$PBT_OBS_DIR" "$MESH_JSON" "$PBT_JSON"' EXIT

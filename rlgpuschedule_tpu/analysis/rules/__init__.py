@@ -19,8 +19,8 @@ class Rule:
 def all_rules() -> list[Rule]:
     from . import (blocking_under_lock, compile_off_thread,
                    device_dispatch_unlocked, donation,
-                   donation_cross_thread, host_sync, impure_in_jit,
-                   prng_reuse, recompile, refusal_drift,
+                   donation_cross_thread, host_sync, hung_future,
+                   impure_in_jit, prng_reuse, recompile, refusal_drift,
                    shared_state_unlocked, sync_in_loop, tracer_leak,
                    unconstrained_intermediate)
     return [donation.RULE, host_sync.RULE, sync_in_loop.RULE,
@@ -28,7 +28,8 @@ def all_rules() -> list[Rule]:
             prng_reuse.RULE, unconstrained_intermediate.RULE,
             compile_off_thread.RULE, device_dispatch_unlocked.RULE,
             donation_cross_thread.RULE, shared_state_unlocked.RULE,
-            blocking_under_lock.RULE, refusal_drift.RULE]
+            blocking_under_lock.RULE, hung_future.RULE,
+            refusal_drift.RULE]
 
 
 def rule_names() -> list[str]:

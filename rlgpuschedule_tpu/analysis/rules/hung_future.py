@@ -1,0 +1,88 @@
+"""hung-future: unbounded waits on futures/queues in threaded modules.
+
+The failure class PR 16's drain contract eliminates: a caller parks on
+``future.result()`` (no timeout) while the thread that would resolve it
+is gone — the dispatcher died, the server drained, the engine was
+ejected. Nothing crashes; the request path just stops, and on a CI rig
+that reads as a 600s timeout with no stack. ``blocking-under-lock``
+catches the two-party deadlock variant (wait while HOLDING a lock);
+this rule catches the one-party variant that needs no lock at all.
+
+Fires in modules that visibly do threading (``threading`` /
+``concurrent.futures`` imports — the same convention gate the
+concurrency model arms its dispatcher-loop roots with) on:
+
+- ``<future>.result()`` with no arguments and no ``timeout=`` — wait
+  bounded by nothing but the process's lifetime;
+- ``<queue>.get(...)`` on a tracked queue object without ``timeout=``
+  (and not ``block=False``; ``get_nowait`` never matches).
+
+Sites already inside a held lock region are skipped — those are
+``blocking-under-lock`` findings (one finding per defect).
+
+The sanctioned shapes: ``result(timeout=...)`` / ``get(timeout=...)``
+(bounded — a stuck wait becomes a loud TimeoutError), or hand the
+future to an event loop via ``asyncio.wrap_future`` and ``await`` it,
+as ``serve.frontend`` does on the wire request path.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+from ..concurrency import _CONVENTION_GATE, model_for
+from ..engine import Finding, ModuleContext, SourceFile
+
+
+def _kw(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    if not any(a in _CONVENTION_GATE or a.startswith("concurrent.")
+               or a.startswith("threading")
+               for a in ctx.aliases.values()):
+        return []
+    model = model_for(ctx)
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        attr = node.func.attr
+        if attr not in ("get", "result"):
+            continue
+        if model.locks_at(node):
+            continue                  # blocking-under-lock's finding
+        if attr == "get":
+            tok = model.value_token(node.func.value, node)
+            if tok is None or tok not in model.queue_tokens:
+                continue
+            block = _kw(node, "block")
+            if isinstance(block, ast.Constant) and block.value is False:
+                continue
+            if _kw(node, "timeout") is not None:
+                continue              # bounded wait
+            what = "queue .get() with no timeout"
+        else:
+            if node.args or node.keywords:
+                continue              # result(timeout=...) is bounded
+            what = "future .result() with no timeout"
+        findings.append(src.finding(
+            node, RULE.name,
+            f"{what} in a threaded module: if the resolving thread is "
+            f"gone (dispatcher died, server drained, engine ejected) "
+            f"this waits forever with no stack — bound it with "
+            f"timeout=..., or await it via asyncio.wrap_future on an "
+            f"event loop"))
+    return findings
+
+
+RULE = Rule(
+    name="hung-future",
+    summary="unbounded future.result() or queue.get() in a threaded "
+            "module (hang with no stack if the resolver dies)",
+    check=_check)

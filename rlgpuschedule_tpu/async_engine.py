@@ -535,7 +535,7 @@ class AsyncRunner:
                     telemetry.begin_iteration(b)
                 with sections("queue_wait"), \
                         tracer.span("queue_pop_wait"):
-                    item, waited = self.queue.get()
+                    item, waited = self.queue.get()  # jsan: disable=hung-future -- TrajectoryQueue.get is bounded by construction (stall timeout + abort wakes every waiter)
                 self._learner_idle_s += waited
                 if item.index != i:
                     raise RuntimeError(
@@ -1014,7 +1014,7 @@ class AsyncPopulationRunner:
                     telemetry.begin_iteration(b)
                 with sections("queue_wait"), \
                         tracer.span("queue_pop_wait"):
-                    item, waited = self.queue.get()
+                    item, waited = self.queue.get()  # jsan: disable=hung-future -- TrajectoryQueue.get is bounded by construction (stall timeout + abort wakes every waiter)
                 self._learner_idle_s += waited
                 if item.index != i:
                     raise RuntimeError(

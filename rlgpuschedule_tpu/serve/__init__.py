@@ -27,21 +27,36 @@ one-at-a-time evaluation.
   single fused-scan dispatch, bit-identical to N sequential
   ``eval.replay`` runs.
 - :mod:`.bench` — the ``serve --bench`` driver: deterministic request
-  streams, zero-recompile steady-state assertion.
+  streams, zero-recompile steady-state assertion; ``run_chaos_soak``
+  paces the fitted trace arrival process through a fleet under
+  injected engine faults and reports the conservation invariant.
+- :mod:`.frontend` — the network front door (PR 16):
+  :class:`ServeFrontend`, an asyncio HTTP listener with zero-copy
+  request decoding, wire deadline propagation (503 + learned
+  ``Retry-After`` on shed), queue-depth connection backpressure, and
+  graceful SIGTERM drain (typed :class:`ServerClosedError` for late
+  submits — never a hung future).
 - ``python -m rlgpuschedule_tpu.serve`` — the CLI (``--bench``,
-  ``--fleet N``, ``--metrics-port`` live Prometheus scrape endpoint).
+  ``--fleet N``, ``--metrics-port`` live Prometheus scrape endpoint,
+  ``--chaos-faults`` engine-fault chaos soak, ``--frontend-port``).
 """
 from .batching import (DeadlineSheddedError, Ewma, PolicyServer, Reservoir,
-                       ServeResult, next_bucket, pad_batch, scatter_results,
-                       stack_requests)
+                       ServeResult, ServerClosedError, next_bucket,
+                       pad_batch, scatter_results, stack_requests)
 from .engine import InferenceEngine
 from .fleet import fleet_replay, fleet_windows, sample_fleet_faults
-from .router import AutoscaleAdvisor, EngineRouter, EngineStats
+from .frontend import FrontendHandle, ServeFrontend, start_frontend
+from .router import (SERVE_FAULT_KINDS, AutoscaleAdvisor, EngineRouter,
+                     EngineStats, InjectedEngineFault, ServeFaultInjector,
+                     ServeFaultSpec, parse_serve_fault)
 
 __all__ = [
     "InferenceEngine", "PolicyServer", "Reservoir", "ServeResult",
-    "DeadlineSheddedError", "Ewma",
+    "DeadlineSheddedError", "ServerClosedError", "Ewma",
     "EngineRouter", "AutoscaleAdvisor", "EngineStats",
+    "SERVE_FAULT_KINDS", "ServeFaultSpec", "ServeFaultInjector",
+    "InjectedEngineFault", "parse_serve_fault",
+    "ServeFrontend", "FrontendHandle", "start_frontend",
     "next_bucket", "pad_batch", "scatter_results", "stack_requests",
     "fleet_replay", "fleet_windows", "sample_fleet_faults",
 ]

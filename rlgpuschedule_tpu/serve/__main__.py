@@ -115,6 +115,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --soak: run the AutoscaleAdvisor loop "
                         "(SLO gauges -> desired engine count, applied "
                         "live by the router with hysteresis)")
+    p.add_argument("--chaos-faults", default=None,
+                   metavar="SPEC[,SPEC...]",
+                   help="with --soak: inject engine faults mid-run "
+                        "(kind@N[:engine=E], kind in engine-raise / "
+                        "engine-hang / engine-slow; N = router dispatch "
+                        "sequence, fires on the target engine's first "
+                        "dispatch >= N). The soak paces arrivals by the "
+                        "config's fitted trace arrival process and "
+                        "gates on exact request conservation; needs "
+                        "--engines >= 2 so the retry hedge has a "
+                        "healthy engine to land on")
+    p.add_argument("--frontend-port", type=int, default=None,
+                   metavar="PORT",
+                   help="with --soak: run the asyncio HTTP front door "
+                        "on this port (0 = ephemeral) and self-check "
+                        "the wire contract after the soak (200 decide, "
+                        "graceful drain, typed late-submit refusal)")
     p.add_argument("--scaleout", action="store_true",
                    help="decisions/s + shed rate vs engine count: "
                         "isolated 1-engine and --engines-engine arms "
@@ -182,6 +199,40 @@ def main(argv: "list[str] | None" = None) -> dict:
     if args.autoscale and args.engines < 2:
         sys.exit("--autoscale resizes a multi-engine router; pass "
                  "--engines >= 2 with it (one engine cannot scale)")
+    chaos_specs = None
+    if args.chaos_faults is not None:
+        if args.soak is None:
+            sys.exit("--chaos-faults injects engine faults during "
+                     "--soak; pass --soak S with it (refusing the "
+                     "silent no-op)")
+        if args.engines < 2:
+            sys.exit("--chaos-faults needs --engines >= 2: the retry "
+                     "hedge moves a failed dispatch to a DIFFERENT "
+                     "healthy engine (one engine has nowhere to go)")
+        if args.autoscale:
+            sys.exit("--chaos-faults runs the chaos soak, which does "
+                     "not drive the autoscale loop; drop --autoscale "
+                     "(refusing the silent no-op)")
+        from .router import parse_serve_fault
+        try:
+            chaos_specs = [parse_serve_fault(s)
+                           for s in args.chaos_faults.split(",") if s]
+        except ValueError as e:
+            sys.exit(str(e))
+        if not chaos_specs:
+            sys.exit("--chaos-faults got no specs")
+        bad_engine = [s for s in chaos_specs
+                      if not 0 <= s.engine < args.engines]
+        if bad_engine:
+            sys.exit(f"--chaos-faults targets engine(s) "
+                     f"{sorted({s.engine for s in bad_engine})} outside "
+                     f"[0, {args.engines})")
+    if args.frontend_port is not None and args.soak is None:
+        sys.exit("--frontend-port runs the HTTP front door around "
+                 "--soak; pass --soak S with it (refusing the silent "
+                 "no-op)")
+    if args.frontend_port is not None and args.frontend_port < 0:
+        sys.exit("--frontend-port must be >= 0 (0 = ephemeral)")
     if args.deadline_ms is not None and args.deadline_ms <= 0:
         sys.exit("--deadline-ms must be positive")
     if (args.deadline_ms is not None and args.soak is None
@@ -279,6 +330,10 @@ def main(argv: "list[str] | None" = None) -> dict:
             scraper = serve_http(registry, port=args.metrics_port)
             print(f"metrics scrape endpoint: {scraper.url}",
                   file=sys.stderr)
+        injector = None
+        if chaos_specs is not None:
+            from .router import ServeFaultInjector
+            injector = ServeFaultInjector(chaos_specs, bus=bus)
         if args.engines > 1:
             from ..parallel.mesh import serve_devices
             avail = len(serve_devices())
@@ -289,7 +344,8 @@ def main(argv: "list[str] | None" = None) -> dict:
             engine = EngineRouter(exp.apply_fn, exp.train_state.params,
                                   exp.env_params, max_bucket=args.bucket,
                                   registry=registry, bus=bus,
-                                  tracer=tracer, n_engines=args.engines)
+                                  tracer=tracer, n_engines=args.engines,
+                                  fault_injector=injector)
             print(f"engine router: {args.engines} engines on "
                   f"{[str(e.device) for e in engine.engines]}"
                   + (" (CPU: dispatch serialized)"
@@ -341,15 +397,42 @@ def main(argv: "list[str] | None" = None) -> dict:
                                            initial=args.engines)
             router = engine if args.engines > 1 else None
             server.start(dispatchers=args.engines)
+            fe_handle = None
             try:
-                soak = run_soak(
-                    server, pool, duration_s=args.soak,
-                    rate_hz=(args.rate if args.rate is not None
-                             else 200.0),
-                    deadline_s=deadline_s, router=router,
-                    advisor=(advisor if router is not None else None))
+                if args.frontend_port is not None:
+                    from .frontend import start_frontend
+                    fe_handle = start_frontend(server, obs0, mask0,
+                                               port=args.frontend_port)
+                    fe_handle.install_sigterm()
+                    print(f"http front door: {fe_handle.url} "
+                          f"(SIGTERM drains gracefully)",
+                          file=sys.stderr)
+                if injector is not None:
+                    from ..traces.fit import domain_fit
+                    from .bench import run_chaos_soak
+                    soak = run_chaos_soak(
+                        server, pool, fit=domain_fit(cfg),
+                        duration_s=args.soak,
+                        rate_hz=(args.rate if args.rate is not None
+                                 else 150.0),
+                        deadline_s=deadline_s, router=router,
+                        seed=cfg.seed)
+                else:
+                    soak = run_soak(
+                        server, pool, duration_s=args.soak,
+                        rate_hz=(args.rate if args.rate is not None
+                                 else 200.0),
+                        deadline_s=deadline_s, router=router,
+                        advisor=(advisor if router is not None
+                                 else None))
+                if fe_handle is not None:
+                    report["frontend"] = _frontend_selfcheck(
+                        fe_handle, obs0, mask0)
             finally:
-                server.stop()
+                if fe_handle is not None:
+                    fe_handle.close()   # drain: also closes the server
+                else:
+                    server.stop()
             server.slo_snapshot()       # final gauge refresh
             soak["post_warmup_recompiles"] = \
                 engine.post_warmup_recompiles
@@ -364,6 +447,20 @@ def main(argv: "list[str] | None" = None) -> dict:
                   + (f"{drift:.2f}x" if drift is not None else "n/a")
                   + f"), post-warmup recompiles: "
                   f"{soak['post_warmup_recompiles']}", file=sys.stderr)
+            if injector is not None:
+                fs = soak["fault_stats"]
+                fired = sum(s.fired for s in chaos_specs)
+                soak["chaos_faults"] = args.chaos_faults
+                soak["faults_fired"] = int(fired)
+                conserved = (soak["conservation_ok"]
+                             and soak["failed"] == 0)
+                print(f"chaos: {fired}/{len(chaos_specs)} faults fired, "
+                      f"engine failures {fs['failures']}, ejections "
+                      f"{fs['ejections']}, readmissions "
+                      f"{fs['readmissions']}, retry hedges "
+                      f"{fs['retry_hedges']}, conservation "
+                      + ("ok" if conserved else "VIOLATED"),
+                      file=sys.stderr)
         if args.scaleout:
             report["scaleout"] = run_scaleout(
                 exp.apply_fn, exp.train_state.params, exp.env_params,
@@ -421,6 +518,47 @@ def main(argv: "list[str] | None" = None) -> dict:
             bus.close()
     print(json.dumps(report))
     return report
+
+
+def _frontend_selfcheck(handle, obs0, mask0) -> dict:
+    """Prove the wire contract on the live front door: one real POST
+    decide must answer 200 with an action (no deadline attached — a
+    cold or loaded server still serves), then a graceful drain, after
+    which a late submit gets the typed :class:`ServerClosedError` (the
+    never-a-hung-future half of the drain contract) and new connections
+    are refused outright."""
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from .batching import ServerClosedError
+
+    body = (np.ascontiguousarray(obs0).tobytes()
+            + np.ascontiguousarray(mask0).tobytes())
+    req = urllib.request.Request(handle.url + "/v1/decide", data=body,
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        decide_status = resp.status
+        payload = json.loads(resp.read().decode())
+    handle.drain()
+    try:
+        handle.frontend.server.submit(obs0, mask0)
+        late_submit = "accepted"          # contract violation
+    except ServerClosedError:
+        late_submit = "server-closed"
+    try:
+        urllib.request.urlopen(
+            urllib.request.Request(handle.url + "/v1/decide", data=body,
+                                   method="POST"), timeout=5)
+        post_drain_connect = "accepted"   # contract violation
+    except (urllib.error.URLError, ConnectionError):
+        post_drain_connect = "refused"
+    return {"url": handle.url, "port": handle.port,
+            "decide_status": decide_status,
+            "decide_has_action": "action" in payload,
+            "drained": True, "late_submit": late_submit,
+            "post_drain_connect": post_drain_connect}
 
 
 def _self_scrape(scraper) -> dict:

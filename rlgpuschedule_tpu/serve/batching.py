@@ -166,6 +166,19 @@ class DeadlineSheddedError(RuntimeError):
             f", waited {waited_s * 1e3:.1f}ms{pred}")
 
 
+class ServerClosedError(RuntimeError):
+    """Typed refusal for submits against a stopped or closed server.
+
+    Raised by :meth:`PolicyServer.submit` while a :meth:`PolicyServer.stop`
+    drain is in flight and forever after :meth:`PolicyServer.close` — the
+    drain half of the no-silent-drop contract: a client racing a shutdown
+    gets a typed, catchable refusal at the door instead of a future that
+    no dispatcher will ever resolve. Distinguishable from
+    :class:`DeadlineSheddedError` (overload, retry later with backoff)
+    and from a bare ``RuntimeError`` (a bug): closed means *this server
+    is going away — re-resolve and connect elsewhere*."""
+
+
 class Ewma:
     """Streaming exponentially-weighted mean — the arrival-rate /
     service-time estimator behind adaptive batching. O(1) memory, no
@@ -252,6 +265,7 @@ class PolicyServer:
         self._occupancies = Reservoir(latency_window, seed=sample_seed + 1)
         self._threads: list[threading.Thread] = []
         self._stopped = False
+        self._closed = False
         self._served = 0
         self._t_first: float | None = None
         self._t_last: float | None = None
@@ -285,6 +299,27 @@ class PolicyServer:
             "submit->result decision latency (cumulative histogram; "
             "aggregatable across ranks/restarts, unlike percentile "
             "gauges)")
+        self._dispatch_errors = self.registry.counter(
+            "serve_dispatch_errors_total",
+            "background pumps that raised after resolving their batch's "
+            "futures exceptionally (the dispatcher survives and keeps "
+            "serving)")
+
+    def _reject(self, fut: Future, exc: DeadlineSheddedError,
+                reason: str) -> None:
+        """Resolve ``fut`` with a typed shed rejection and count it in
+        ``serve_shed_total`` — counting gated on WINNING the future's
+        state transition, so a request raced by two dispatchers' expiry
+        scans (or abandoned via ``Future.cancel``) is counted at most
+        once, and only when someone will actually observe the rejection.
+        Conservation (submitted == resolved + shed) is structural, not
+        best-effort."""
+        try:
+            fut.set_exception(exc)
+        except BaseException:   # cancelled, or already resolved elsewhere
+            return
+        self._shed.inc()
+        self.tracer.instant("shed", reason=reason)
 
     def submit(self, obs: Any, mask: Any, stall: int = 0,
                deadline_s: "float | None" = None) -> Future:
@@ -308,8 +343,12 @@ class PolicyServer:
                        deadline_s=(None if deadline_s is None
                                    else float(deadline_s)))
         with self._wake:
+            if self._closed:
+                raise ServerClosedError(
+                    "PolicyServer is closed (drained for shutdown)")
             if self._stopped:
-                raise RuntimeError("PolicyServer is stopped")
+                raise ServerClosedError(
+                    "PolicyServer is stopped (drain in flight)")
             self._requests.inc()
             if self._t_prev_submit is not None:
                 self._arrival_gap.update(now - self._t_prev_submit)
@@ -322,11 +361,9 @@ class PolicyServer:
                           // self.engine.max_bucket)
                 predicted = ahead * svc
                 if predicted > req.deadline_s:
-                    self._shed.inc()
-                    fut.set_exception(DeadlineSheddedError(
+                    self._reject(fut, DeadlineSheddedError(
                         "admission", req.deadline_s, waited_s=0.0,
-                        predicted_wait_s=predicted))
-                    self.tracer.instant("shed", reason="admission")
+                        predicted_wait_s=predicted), reason="admission")
                     return fut
             self._pending.append(req)
             self._wake.notify()
@@ -345,12 +382,9 @@ class PolicyServer:
         for r in self._pending:
             if (r.deadline_s is not None
                     and now - r.t_submit > r.deadline_s):
-                self._shed.inc()
-                if not r.future.cancelled():
-                    r.future.set_exception(DeadlineSheddedError(
-                        "expired", r.deadline_s,
-                        waited_s=now - r.t_submit))
-                self.tracer.instant("shed", reason="expired")
+                self._reject(r.future, DeadlineSheddedError(
+                    "expired", r.deadline_s,
+                    waited_s=now - r.t_submit), reason="expired")
             else:
                 keep.append(r)
         self._pending = keep
@@ -482,6 +516,8 @@ class PolicyServer:
         ``serve.router.EngineRouter``)."""
         if self._threads:
             raise RuntimeError("dispatcher already running")
+        if self._closed:
+            raise ServerClosedError("PolicyServer is closed")
         if dispatchers < 1:
             raise ValueError(f"dispatchers must be >= 1, got {dispatchers}")
         self._stopped = False
@@ -493,7 +529,14 @@ class PolicyServer:
                         self._wake.wait()
                     if self._stopped and not self._pending:
                         return
-                self.pump()
+                try:
+                    self.pump()
+                except Exception:
+                    # the pump already resolved its batch's futures with
+                    # the exception (no silent drop); a dead dispatcher
+                    # would strand every LATER request as a hung future,
+                    # so survive the failed dispatch and keep draining
+                    self._dispatch_errors.inc()
 
         for i in range(dispatchers):
             t = threading.Thread(target=loop,
@@ -514,7 +557,48 @@ class PolicyServer:
             t.join(timeout=30)
         self._threads = []
         with self._wake:
-            self._stopped = False
+            # a close() drain is terminal; a stop() drain returns the
+            # server to inline mode
+            self._stopped = self._closed
+
+    def close(self) -> None:
+        """Permanent :meth:`stop`: drain the queue, stop the dispatchers,
+        then refuse every later :meth:`submit` (and :meth:`start`) with
+        :class:`ServerClosedError` forever. The terminal half of the
+        frontend's graceful-drain contract — after ``close`` returns,
+        every future ever handed out has resolved (result, shed, or
+        dispatch error) and no future will ever be created that can't.
+        Idempotent."""
+        with self._wake:
+            self._closed = True
+        self.stop()
+        # inline-mode close: no dispatcher drained the queue, so flush it
+        # here — every already-accepted future must resolve (each pump
+        # consumes its batch even when the dispatch raises, so this
+        # terminates)
+        while True:
+            try:
+                if not self.pump():
+                    break
+            except Exception:
+                self._dispatch_errors.inc()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def queue_depth(self) -> int:
+        """Requests currently queued (the frontend's backpressure
+        signal — sampled, so momentarily stale values are fine)."""
+        with self._lock:
+            return len(self._pending)
+
+    def service_time_s(self) -> "float | None":
+        """The learned per-dispatch service time (Ewma), ``None`` until
+        the first dispatch — what the frontend derives ``Retry-After``
+        from for shed responses."""
+        with self._lock:
+            return self._service_time.value
 
     # ---- SLO surface -------------------------------------------------
 

@@ -285,3 +285,118 @@ def run_soak(server, pool: "list[tuple[Any, Any]]", *,
         out["engines_active"] = router.n_active
         out["serialized_dispatch_cpu"] = router.serialized_dispatch()
     return out
+
+
+def fit_paced_gaps(fit, n: int, seed, rate_hz: float) -> np.ndarray:
+    """Inter-arrival gaps carrying a fitted workload's arrival SHAPE at
+    a chosen offered rate: realize one seeded window from ``fit``
+    (:func:`~..traces.fit.gen_domain_window` — the same arrival process
+    the simulator replays), take its inter-arrival gaps, and rescale
+    them so the mean gap is exactly ``1/rate_hz``. The soak then pounds
+    the server with the trace's burstiness, not a metronome — idle
+    stretches and pile-ups included — while the offered load stays the
+    configured number. Deterministic per (fit, seed)."""
+    from ..traces.fit import gen_domain_window
+
+    if n < 1:
+        raise ValueError(f"need at least one gap, got n={n}")
+    if rate_hz <= 0:
+        raise ValueError(f"rate_hz must be positive, got {rate_hz}")
+    win = gen_domain_window(fit, n_jobs=n + 1, seed=seed, n_gpus=8,
+                            load=1.0)
+    gaps = np.maximum(np.diff(win.submit.astype(np.float64)), 0.0)
+    mean = float(gaps.mean())
+    if mean <= 0:       # degenerate window (all-burst); fall back flat
+        return np.full(n, 1.0 / rate_hz)
+    return gaps * ((1.0 / rate_hz) / mean)
+
+
+def run_chaos_soak(server, pool: "list[tuple[Any, Any]]", *, fit,
+                   duration_s: float = 6.0, rate_hz: float = 150.0,
+                   deadline_s: "float | None" = None, router=None,
+                   seed: int = 0) -> dict:
+    """:func:`run_soak` graduated to chaos: replay-paced load
+    (:func:`fit_paced_gaps` — the fitted trace's arrival process, not a
+    fixed interval) through a RUNNING dispatcher fleet while a
+    :class:`~.router.ServeFaultInjector` (attached to the router by the
+    caller) fails engines mid-run. Every future is awaited with a bound
+    and bucketed into exactly one of served / shed / failed, so the
+    report carries the conservation invariant directly::
+
+        submitted == served + shed + failed      (failed must be 0:
+        the retry hedge absorbs injected engine faults)
+
+    plus the exactly-once counter cross-check (``registry_shed_total``
+    must equal the shed futures actually observed) and the router's
+    ejection/readmission/hedge story (:meth:`~.router.EngineRouter.
+    fault_stats`)."""
+    from .batching import DeadlineSheddedError
+
+    n_gaps = max(int(duration_s * rate_hz * 2) + 16, 1)
+    gaps = fit_paced_gaps(fit, n_gaps, seed=(seed, 0xC7A05),
+                          rate_hz=rate_hz)
+    futures = []
+    cursor = 0
+    t_start = time.perf_counter()
+    next_t = t_start
+    while time.perf_counter() - t_start < duration_s:
+        obs, mask = pool[cursor % len(pool)]
+        futures.append(server.submit(obs, mask, deadline_s=deadline_s))
+        next_t += gaps[cursor % len(gaps)]
+        cursor += 1
+        sleep = next_t - time.perf_counter()
+        if sleep > 0:
+            time.sleep(sleep)
+    lat_s: "list[float | None]" = []
+    shed = 0
+    failed = 0
+    failure_kinds: dict[str, int] = {}
+    for f in futures:
+        try:
+            lat_s.append(f.result(timeout=30).latency_s)
+        except DeadlineSheddedError:
+            shed += 1
+            lat_s.append(None)
+        except Exception as e:   # incl. a hung future's TimeoutError
+            failed += 1
+            kind = type(e).__name__
+            failure_kinds[kind] = failure_kinds.get(kind, 0) + 1
+            lat_s.append(None)
+    wall = time.perf_counter() - t_start
+    served = len(futures) - shed - failed
+
+    def p99_ms(xs):
+        xs = [x for x in xs if x is not None]
+        return (float(np.percentile(np.asarray(xs), 99) * 1e3)
+                if xs else None)
+
+    half = len(lat_s) // 2
+    p99_a, p99_b = p99_ms(lat_s[:half]), p99_ms(lat_s[half:])
+    reg = server.registry
+    out = {
+        "requests": len(futures),
+        "served": served,
+        "shed": shed,
+        "failed": failed,
+        "failure_kinds": failure_kinds,
+        "conservation_ok": len(futures) == served + shed + failed,
+        "registry_requests_total": int(
+            reg.counter("serve_requests_total").value),
+        "registry_shed_total": int(reg.counter("serve_shed_total").value),
+        "shed_rate": shed / max(len(futures), 1),
+        "duration_s": wall,
+        "rate_hz": rate_hz,
+        "arrival_fit": fit.name,
+        "deadline_s": deadline_s,
+        "p99_first_half_ms": p99_a,
+        "p99_second_half_ms": p99_b,
+        "p99_drift": (p99_b / p99_a
+                      if p99_a and p99_b and p99_a > 0 else None),
+    }
+    if router is not None:
+        out["fault_stats"] = router.fault_stats()
+        out["per_engine_rows"] = [s.rows for s in router.stats()]
+        out["per_engine_recompiles"] = router.per_engine_recompiles()
+        out["engines_active"] = router.n_active
+        out["serialized_dispatch_cpu"] = router.serialized_dispatch()
+    return out

@@ -1,0 +1,433 @@
+"""The network front door: asyncio HTTP in front of the serving stack.
+
+Everything below ``submit()`` already speaks overload fluently — typed
+shedding, adaptive bucketing, engine health — but none of it had ever
+faced a socket. This module is the thinnest honest wire layer over
+:class:`~.batching.PolicyServer` (single engine or
+:class:`~.router.EngineRouter` fleet alike), built so that every
+failure the serving tier can produce has ONE well-defined HTTP shape:
+
+- ``POST /v1/decide`` carries one request's observation + action-mask
+  bytes raw in the body (shapes/dtypes fixed at construction from an
+  example request). The body is read once off the socket and viewed
+  **zero-copy** with ``np.frombuffer`` — the first copy of a request's
+  bytes is the batch stack itself, exactly like an in-process submit.
+- ``X-Deadline-Ms`` propagates the client's latency SLO into the
+  admission/shedding path. A shed request returns **503** with a
+  ``Retry-After`` derived from the LEARNED service-time Ewma (plus the
+  predicted excess wait on admission sheds) — the server tells the
+  client how long the queue actually needs, instead of a made-up
+  constant.
+- **Backpressure is connection-level**: past a queue-depth high-water
+  mark the listener simply stops reading sockets (an ``asyncio.Event``
+  gate ahead of every read), resuming at low-water — unread bytes pile
+  up in kernel buffers and TCP pushes back on the client, so overload
+  never manifests as an unbounded server-side queue.
+- **Graceful drain** (SIGTERM or :meth:`ServeFrontend.drain`): stop
+  accepting connections, let every in-flight request resolve, then
+  :meth:`~.batching.PolicyServer.close` the server so late submits get
+  a typed :class:`~.batching.ServerClosedError` → **503** — never a
+  hung future, never a silently dropped request.
+
+The listener is stdlib-only (``asyncio.start_server`` + hand-rolled
+HTTP/1.1) on purpose: no new dependency, and the protocol surface is
+small enough to pin completely in tier-1 tests. gRPC and multi-node
+ingestion stay ROADMAP open ends.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import signal
+import threading
+from concurrent.futures import Future
+from typing import Any
+
+import numpy as np
+
+from .batching import DeadlineSheddedError, PolicyServer, ServerClosedError
+
+DECIDE_PATH = "/v1/decide"
+HEALTH_PATH = "/healthz"
+
+
+def _response(status: str, payload: dict,
+              extra_headers: "tuple[str, ...]" = ()) -> bytes:
+    body = json.dumps(payload).encode()
+    head = [f"HTTP/1.1 {status}",
+            "Content-Type: application/json",
+            f"Content-Length: {len(body)}",
+            "Connection: keep-alive", *extra_headers]
+    return ("\r\n".join(head) + "\r\n\r\n").encode() + body
+
+
+class _BadRequest(Exception):
+    """Malformed wire input; maps to 400 without killing the connection."""
+
+
+class ServeFrontend:
+    """One asyncio HTTP listener over a :class:`PolicyServer`.
+
+    Run it natively with ``await fe.start()`` inside an event loop, or
+    from synchronous code via :func:`start_frontend` (dedicated loop
+    thread). ``example_obs`` / ``example_mask`` fix the wire schema:
+    one request's body is exactly ``obs.nbytes + mask.nbytes`` raw
+    bytes in that order, C-contiguous, same dtypes.
+    """
+
+    def __init__(self, server: PolicyServer, example_obs: Any,
+                 example_mask: Any, host: str = "127.0.0.1",
+                 port: int = 0, registry=None,
+                 high_water: int = 256, low_water: int = 64,
+                 poll_s: float = 0.005, request_timeout_s: float = 120.0,
+                 drain_grace_s: float = 30.0):
+        if not 0 <= low_water < high_water:
+            raise ValueError(f"need 0 <= low_water < high_water, got "
+                             f"{low_water} / {high_water}")
+        self.server = server
+        self.host = host
+        self.port = int(port)            # 0 = ephemeral; set by start()
+        self.high_water = int(high_water)
+        self.low_water = int(low_water)
+        self.poll_s = float(poll_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.drain_grace_s = float(drain_grace_s)
+        obs0 = np.ascontiguousarray(example_obs)
+        mask0 = np.ascontiguousarray(example_mask)
+        self._obs_shape, self._obs_dtype = obs0.shape, obs0.dtype
+        self._mask_shape, self._mask_dtype = mask0.shape, mask0.dtype
+        self._obs_nbytes, self._mask_nbytes = obs0.nbytes, mask0.nbytes
+        self._draining = False
+        self._inflight = 0
+        self._tcp: "asyncio.base_events.Server | None" = None
+        self._gate: "asyncio.Event | None" = None       # set = reads flow
+        self._idle: "asyncio.Event | None" = None       # set = no inflight
+        self._bp_task: "asyncio.Task | None" = None
+        reg = registry if registry is not None else server.registry
+        self._http_requests = reg.counter(
+            "serve_frontend_requests_total",
+            "HTTP decide requests read off the wire")
+        self._http_shed = reg.counter(
+            "serve_frontend_shed_total",
+            "HTTP decide requests answered 503 with Retry-After "
+            "(deadline shed)")
+        self._http_closed = reg.counter(
+            "serve_frontend_closed_total",
+            "HTTP decide requests refused because the server is "
+            "draining/closed")
+        self._http_bad = reg.counter(
+            "serve_frontend_bad_requests_total",
+            "HTTP requests answered 400 (malformed wire input)")
+        self._pauses = reg.counter(
+            "serve_frontend_backpressure_pauses_total",
+            "times the listener stopped reading sockets at the "
+            "queue-depth high-water mark")
+        self._g_paused = reg.gauge(
+            "serve_frontend_paused",
+            "1 while socket reads are paused for backpressure")
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # ---- lifecycle ---------------------------------------------------
+
+    async def start(self) -> int:
+        """Bind and serve (returns immediately; the listener runs on
+        the current event loop). Returns the bound port."""
+        if self._tcp is not None:
+            raise RuntimeError("frontend already started")
+        self._gate = asyncio.Event()
+        self._gate.set()
+        self._idle = asyncio.Event()
+        self._idle.set()
+        self._tcp = await asyncio.start_server(
+            self._on_connection, self.host, self.port)
+        self.port = self._tcp.sockets[0].getsockname()[1]
+        self._bp_task = asyncio.get_running_loop().create_task(
+            self._backpressure_loop())
+        return self.port
+
+    async def drain(self) -> None:
+        """Graceful shutdown: stop accepting, flush in-flight requests,
+        then permanently close the policy server so any straggler
+        submit raises :class:`ServerClosedError` — the never-a-hung-
+        future half of the contract. Idempotent."""
+        already = self._draining
+        self._draining = True
+        if self._tcp is not None:
+            self._tcp.close()
+            await self._tcp.wait_closed()
+        if self._gate is not None:
+            # wake paused readers: their next request gets a typed 503
+            self._gate.set()
+        if self._idle is not None:
+            await asyncio.wait_for(self._idle.wait(), self.drain_grace_s)
+        if self._bp_task is not None:
+            self._bp_task.cancel()   # idempotent; keep the handle
+        if not already:
+            # PolicyServer.close joins dispatcher threads — off-loop
+            await asyncio.to_thread(self.server.close)
+
+    # ---- backpressure ------------------------------------------------
+
+    async def _backpressure_loop(self) -> None:
+        """Sample queue depth; gate socket reads between the high- and
+        low-water marks (classic hysteresis so the gate cannot flap on
+        a depth hovering at one threshold)."""
+        assert self._gate is not None
+        while not self._draining:
+            depth = self.server.queue_depth()
+            if self._gate.is_set():
+                if depth >= self.high_water:
+                    self._gate.clear()
+                    self._pauses.inc()
+                    self._g_paused.set(1)
+            elif depth <= self.low_water:
+                self._gate.set()
+                self._g_paused.set(0)
+            await asyncio.sleep(self.poll_s)
+
+    # ---- connection handling -----------------------------------------
+
+    async def _on_connection(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        assert self._gate is not None and self._idle is not None
+        try:
+            while True:
+                # connection-level backpressure: do not even READ the
+                # next request while the queue is past high-water
+                if not self._gate.is_set():
+                    await self._gate.wait()
+                req = await self._read_request(reader)
+                if req is None:
+                    return
+                try:
+                    resp = await self._handle(*req)
+                except _BadRequest as e:
+                    self._http_bad.inc()
+                    resp = _response("400 Bad Request",
+                                     {"error": "bad-request",
+                                      "detail": str(e)})
+                writer.write(resp)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            return   # client went away mid-request; nothing to answer
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    async def _read_request(self, reader: asyncio.StreamReader):
+        line = await reader.readline()
+        if not line:
+            return None       # clean EOF between requests
+        parts = line.decode("latin-1").split()
+        if len(parts) != 3:
+            raise _BadRequest("malformed request line")
+        method, path = parts[0], parts[1]
+        headers: dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            key, _, val = h.decode("latin-1").partition(":")
+            headers[key.strip().lower()] = val.strip()
+        try:
+            length = int(headers.get("content-length", "0") or "0")
+        except ValueError as e:
+            raise _BadRequest("bad Content-Length") from e
+        body = await reader.readexactly(length) if length > 0 else b""
+        return method, path, headers, body
+
+    def _parse_body(self, body: bytes) -> "tuple[Any, Any]":
+        expected = self._obs_nbytes + self._mask_nbytes
+        if len(body) != expected:
+            raise _BadRequest(
+                f"body must be exactly {expected} bytes "
+                f"(obs {self._obs_shape} {self._obs_dtype} + mask "
+                f"{self._mask_shape} {self._mask_dtype}), got {len(body)}")
+        # zero-copy: read-only views over the received bytes; the first
+        # copy is the batch stack, same as an in-process submit
+        obs = np.frombuffer(
+            body, dtype=self._obs_dtype,
+            count=int(np.prod(self._obs_shape, dtype=np.int64)),
+        ).reshape(self._obs_shape)
+        mask = np.frombuffer(
+            body, dtype=self._mask_dtype, offset=self._obs_nbytes,
+            count=int(np.prod(self._mask_shape, dtype=np.int64)),
+        ).reshape(self._mask_shape)
+        return obs, mask
+
+    def _retry_after_s(self, exc: DeadlineSheddedError) -> float:
+        """Honest backoff hint: one learned service time (the cost of
+        the dispatch that has to finish before the queue moves), plus
+        the predicted excess wait on admission sheds. Always finite and
+        positive; 1s only when the estimator is still cold (a shed with
+        a cold estimator can only be an in-queue expiry)."""
+        svc = self.server.service_time_s()
+        retry = svc if svc is not None else 1.0
+        if exc.predicted_wait_s is not None:
+            retry += max(exc.predicted_wait_s - exc.deadline_s, 0.0)
+        return max(retry, 1e-3)
+
+    async def _handle(self, method: str, path: str, headers: dict,
+                      body: bytes) -> bytes:
+        if method == "GET" and path == HEALTH_PATH:
+            return _response("200 OK", {
+                "status": "draining" if self._draining else "ok",
+                "queue_depth": self.server.queue_depth()})
+        if method != "POST" or path != DECIDE_PATH:
+            return _response("404 Not Found", {"error": "unknown route",
+                                               "path": path})
+        self._http_requests.inc()
+        if self._draining:
+            self._http_closed.inc()
+            return _response("503 Service Unavailable",
+                             {"error": "closed",
+                              "detail": "server is draining"})
+        obs, mask = self._parse_body(body)
+        deadline_s = None
+        if "x-deadline-ms" in headers:
+            try:
+                deadline_s = float(headers["x-deadline-ms"]) / 1e3
+            except ValueError as e:
+                raise _BadRequest("bad X-Deadline-Ms") from e
+            if not (math.isfinite(deadline_s) and deadline_s > 0):
+                raise _BadRequest("X-Deadline-Ms must be finite and > 0")
+        try:
+            stall = int(headers.get("x-stall", "0") or "0")
+        except ValueError as e:
+            raise _BadRequest("bad X-Stall") from e
+
+        assert self._idle is not None
+        self._inflight += 1
+        self._idle.clear()
+        try:
+            try:
+                fut = self.server.submit(obs, mask, stall=stall,
+                                         deadline_s=deadline_s)
+            except ServerClosedError:
+                self._http_closed.inc()
+                return _response("503 Service Unavailable",
+                                 {"error": "closed",
+                                  "detail": "server is draining"})
+            try:
+                result = await asyncio.wait_for(
+                    asyncio.wrap_future(fut), self.request_timeout_s)
+            except DeadlineSheddedError as e:
+                retry = self._retry_after_s(e)
+                self._http_shed.inc()
+                return _response(
+                    "503 Service Unavailable",
+                    {"error": "shed", "reason": e.reason,
+                     "deadline_ms": e.deadline_s * 1e3,
+                     "waited_ms": e.waited_s * 1e3,
+                     "retry_after_s": retry},
+                    (f"Retry-After: {retry:.3f}",))
+            except ServerClosedError:
+                self._http_closed.inc()
+                return _response("503 Service Unavailable",
+                                 {"error": "closed",
+                                  "detail": "server closed mid-request"})
+            except asyncio.TimeoutError:
+                return _response("504 Gateway Timeout",
+                                 {"error": "timeout",
+                                  "timeout_s": self.request_timeout_s})
+        finally:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle.set()
+        import jax
+        action = jax.tree.map(lambda x: np.asarray(x).tolist(),
+                              result.action)
+        return _response("200 OK", {"action": action,
+                                    "latency_ms": result.latency_s * 1e3})
+
+
+class FrontendHandle:
+    """Synchronous handle over a :class:`ServeFrontend` running on its
+    own event-loop thread (:func:`start_frontend`). Every wait is
+    bounded — a handle can never hang its caller."""
+
+    def __init__(self, frontend: ServeFrontend,
+                 loop: asyncio.AbstractEventLoop,
+                 thread: threading.Thread):
+        self.frontend = frontend
+        self._loop = loop
+        self._thread = thread
+        self._prev_sigterm = None
+
+    @property
+    def port(self) -> int:
+        return self.frontend.port
+
+    @property
+    def url(self) -> str:
+        return self.frontend.url
+
+    def drain(self, timeout: float = 60.0) -> None:
+        """Run the graceful drain to completion (blocking, bounded)."""
+        asyncio.run_coroutine_threadsafe(
+            self.frontend.drain(), self._loop).result(timeout=timeout)
+
+    def install_sigterm(self) -> None:
+        """SIGTERM → graceful drain (scheduled on the loop thread; the
+        signal handler itself never blocks). Main thread only."""
+        def _on_sigterm(signum, frame):
+            asyncio.run_coroutine_threadsafe(
+                self.frontend.drain(), self._loop)
+        self._prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+
+    def close(self, timeout: float = 60.0) -> None:
+        """Drain (if not already) then stop and join the loop thread."""
+        try:
+            self.drain(timeout=timeout)
+        finally:
+            if self._prev_sigterm is not None:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+                self._prev_sigterm = None
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=10)
+
+
+def start_frontend(server: PolicyServer, example_obs: Any,
+                   example_mask: Any, **kw: Any) -> FrontendHandle:
+    """Start a :class:`ServeFrontend` on a dedicated event-loop thread
+    and block (bounded) until it is bound. Keyword args pass through to
+    the :class:`ServeFrontend` constructor."""
+    fe = ServeFrontend(server, example_obs, example_mask, **kw)
+    loop = asyncio.new_event_loop()
+    bound: Future = Future()
+
+    def _frontend_loop():
+        asyncio.set_event_loop(loop)
+        try:
+            port = loop.run_until_complete(fe.start())
+        except BaseException as e:   # bind failure must not hang callers
+            bound.set_exception(e)
+            loop.close()
+            return
+        bound.set_result(port)
+        try:
+            loop.run_forever()
+        finally:
+            # cancel stragglers so close() leaves a clean loop behind
+            for task in asyncio.all_tasks(loop):
+                task.cancel()
+            loop.run_until_complete(
+                loop.shutdown_asyncgens())
+            loop.close()
+
+    t = threading.Thread(target=_frontend_loop, name="serve-frontend",
+                         daemon=True)
+    t.start()
+    bound.result(timeout=30)
+    return FrontendHandle(fe, loop, t)

@@ -44,13 +44,120 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import threading
+import time
 from typing import Any
 
 import jax
 
 from ..obs.trace import NULL_TRACER
 from ..parallel.mesh import serve_devices
+from .batching import stack_requests
 from .engine import InferenceEngine
+
+SERVE_FAULT_KINDS = ("engine-raise", "engine-hang", "engine-slow")
+
+
+class InjectedEngineFault(RuntimeError):
+    """The exception an injected serving fault surfaces as — typed so
+    tests and the retry hedge can tell an injected crash from a real
+    one, and so the hedge provably absorbs exactly the injected set."""
+
+
+@dataclasses.dataclass
+class ServeFaultSpec:
+    """One armed serving fault: fires on the first not-yet-fired router
+    dispatch with sequence number >= ``at`` that lands on ``engine``.
+
+    ``>=`` rather than ``==`` on purpose: unlike training iterations
+    (``resilience.FaultSpec``), which engine serves dispatch N is a race
+    between pump threads — an exact-match spec could miss its engine
+    forever. Each spec still fires exactly once."""
+    kind: str        # one of SERVE_FAULT_KINDS
+    at: int          # router-global dispatch sequence number (>= fires)
+    engine: int = 0  # target engine id
+    fired: bool = False
+
+
+def parse_serve_fault(spec: str) -> ServeFaultSpec:
+    """Parse ``kind@N[:engine=E]`` (e.g. ``engine-raise@40``,
+    ``engine-hang@10:engine=1``) — the serving twin of
+    :func:`~..resilience.faults.parse_fault`. Raises ValueError with the
+    offending spec."""
+    body = spec.strip()
+    engine = 0
+    if ":" in body:
+        body, _, opt = body.partition(":")
+        key, _, val = opt.partition("=")
+        if key.strip() != "engine" or not val.strip().lstrip("-").isdigit():
+            raise ValueError(f"bad serve-fault option {opt!r} in {spec!r} "
+                             f"(expected engine=E)")
+        engine = int(val)
+    kind, sep, at = body.partition("@")
+    kind = kind.strip()
+    if kind not in SERVE_FAULT_KINDS or not sep or not at.strip().isdigit():
+        raise ValueError(
+            f"bad serve-fault spec {spec!r}; expected kind@N[:engine=E] "
+            f"with kind in {SERVE_FAULT_KINDS}")
+    return ServeFaultSpec(kind=kind, at=int(at), engine=engine)
+
+
+class ServeFaultInjector:
+    """Deterministic engine-fault injection for the serving tier,
+    mirroring :class:`~..resilience.faults.FaultInjector`: holds parsed
+    specs, every hook is a no-op unless an armed spec matches, each spec
+    fires exactly once, firings land on the event bus before the fault
+    takes effect. Three kinds, one per failure shape:
+
+    - ``engine-raise`` — the dispatch raises immediately (XLA error /
+      device loss surfacing synchronously);
+    - ``engine-hang`` — the dispatch stalls ``hang_s`` then raises, as a
+      hang reaped by a dispatch timeout would (bounded, so tier-1 tests
+      never actually hang);
+    - ``engine-slow`` — the dispatch stalls ``slow_s`` then SUCCEEDS
+      (brownout: the engine is slow, not wrong — health tracking must
+      not eject it for latency alone).
+    """
+
+    def __init__(self, specs: "list[ServeFaultSpec]", bus=None,
+                 hang_s: float = 0.2, slow_s: float = 0.05):
+        self.specs = list(specs)
+        self._bus = bus   # obs.EventBus (or None): fault firings
+        self.hang_s = float(hang_s)
+        self.slow_s = float(slow_s)
+        self._lock = threading.Lock()
+
+    def _take(self, engine: int, seq: int) -> "ServeFaultSpec | None":
+        with self._lock:   # pump threads race the same spec list
+            for s in self.specs:
+                if s.engine == engine and seq >= s.at and not s.fired:
+                    s.fired = True
+                    return s
+        return None
+
+    def _emit(self, spec: ServeFaultSpec, **fields: Any) -> None:
+        if self._bus is not None:
+            self._bus.emit("serve_fault", fault=spec.kind, at=spec.at,
+                           engine=spec.engine, **fields)
+
+    def on_dispatch(self, engine: int, seq: int) -> None:
+        """Hook the router calls right before device work for dispatch
+        ``seq`` on ``engine`` (probes included — a persistent fault
+        keeps failing the re-probe and the engine stays ejected)."""
+        spec = self._take(engine, seq)
+        if spec is None:
+            return
+        self._emit(spec, dispatch=seq)
+        if spec.kind == "engine-slow":
+            time.sleep(self.slow_s)
+            return
+        if spec.kind == "engine-hang":
+            time.sleep(self.hang_s)
+            raise InjectedEngineFault(
+                f"engine {engine} hung on dispatch {seq} (injected "
+                f"{spec.kind}@{spec.at}, reaped after {self.hang_s}s)")
+        raise InjectedEngineFault(
+            f"engine {engine} raised on dispatch {seq} (injected "
+            f"{spec.kind}@{spec.at})")
 
 
 @dataclasses.dataclass
@@ -64,6 +171,8 @@ class EngineStats:
     rows: int              # real request rows served, lifetime
     slots: int             # bucket rows dispatched (rows + padding)
     recompiles: int        # post-warmup recompile alarms (must stay 0)
+    ejected: bool = False  # health-ejected (distinct from !active)
+    consecutive_failures: int = 0
 
     @property
     def occupancy(self) -> "float | None":
@@ -92,10 +201,19 @@ class EngineRouter:
     def __init__(self, apply_fn, net_params: Any, env_params: Any = None,
                  max_bucket: int = 256, registry=None, bus=None,
                  strict: bool = False, stall_gate: bool = True,
-                 tracer=None, n_engines: "int | None" = None, mesh=None):
+                 tracer=None, n_engines: "int | None" = None, mesh=None,
+                 fault_injector: "ServeFaultInjector | None" = None,
+                 eject_after: int = 2, probe_backoff_s: float = 0.25,
+                 probe_backoff_max_s: float = 8.0, clock=time.monotonic):
         from ..obs import Registry
         self.registry = registry if registry is not None else Registry()
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        if eject_after < 1:
+            raise ValueError(f"eject_after must be >= 1, got {eject_after}")
+        if probe_backoff_s <= 0 or probe_backoff_max_s < probe_backoff_s:
+            raise ValueError(
+                f"need 0 < probe_backoff_s <= probe_backoff_max_s, got "
+                f"{probe_backoff_s} / {probe_backoff_max_s}")
         devices = serve_devices(mesh)
         if n_engines is None:
             n_engines = len(devices)
@@ -128,6 +246,19 @@ class EngineRouter:
         self._slots = [0] * n_engines
         self._dispatch_counts = [0] * n_engines
         self._example: "tuple[Any, Any] | None" = None
+        # ---- health tracking (ejection / backoff re-probe) ----------
+        self._bus = bus
+        self._injector = fault_injector
+        self.eject_after = int(eject_after)
+        self.probe_backoff_s = float(probe_backoff_s)
+        self.probe_backoff_max_s = float(probe_backoff_max_s)
+        self._clock = clock
+        self._dispatch_seq = 0          # router-global, probes included
+        self._consec_fail = [0] * n_engines
+        self._ejected = [False] * n_engines
+        self._eject_until = [0.0] * n_engines
+        self._backoff = [float(probe_backoff_s)] * n_engines
+        self._probing = [False] * n_engines
         self._eng_dispatches = [
             self.registry.counter(
                 "serve_engine_dispatches_total",
@@ -146,6 +277,33 @@ class EngineRouter:
                 "real rows / bucket rows of this engine's last dispatch",
                 labels={"engine": str(i)})
             for i in range(n_engines)]
+        self._eng_failures = [
+            self.registry.counter(
+                "serve_engine_failures_total",
+                "dispatches on this engine that raised (probe failures "
+                "included)",
+                labels={"engine": str(i)})
+            for i in range(n_engines)]
+        self._eng_ejections = [
+            self.registry.counter(
+                "serve_engine_ejections_total",
+                "times this engine was health-ejected from routing after "
+                "consecutive dispatch failures",
+                labels={"engine": str(i)})
+            for i in range(n_engines)]
+        self._eng_readmissions = [
+            self.registry.counter(
+                "serve_engine_readmissions_total",
+                "times this engine passed its re-probe and rejoined "
+                "routing",
+                labels={"engine": str(i)})
+            for i in range(n_engines)]
+        self._retries = self.registry.counter(
+            "serve_retry_hedges_total",
+            "batches retried once on a healthy engine after their first "
+            "engine's dispatch failed")
+        self._g_ejected = self.registry.gauge(
+            "serve_engines_ejected", "engines currently health-ejected")
         self._g_total = self.registry.gauge(
             "serve_engines_total", "engines resolved from the mesh")
         self._g_active = self.registry.gauge(
@@ -188,14 +346,17 @@ class EngineRouter:
 
     # ---- dispatch ----------------------------------------------------
 
-    def _acquire(self) -> int:
-        """Pick the least-loaded active engine and book an inflight slot
-        (fewest inflight, then fewest lifetime rows, then lowest id)."""
+    def _acquire(self, exclude: "int | None" = None) -> int:
+        """Pick the least-loaded active, healthy engine and book an
+        inflight slot (fewest inflight, then fewest lifetime rows, then
+        lowest id). ``exclude`` bars the engine a retry hedge just
+        failed on."""
         with self._lock:
             candidates = [i for i in range(len(self.engines))
-                          if self._active[i]]
+                          if self._active[i] and not self._ejected[i]
+                          and i != exclude]
             if not candidates:
-                raise RuntimeError("no active engines")
+                raise RuntimeError("no active healthy engines")
             eid = min(candidates,
                       key=lambda i: (self._inflight[i], self._rows[i], i))
             self._inflight[eid] += 1
@@ -212,19 +373,153 @@ class EngineRouter:
                 self._eng_rows[eid].inc(rows)
                 self._eng_occupancy[eid].set(rows / bucket)
 
-    def decide(self, obs: Any, mask: Any, stall=None) -> "tuple[Any, int]":
-        """One routed batch decision — same signature and result as
-        :meth:`.engine.InferenceEngine.decide` (bit-identical, per the
-        module-docstring contract)."""
-        n = int(jax.tree.leaves(obs)[0].shape[0])
-        eid = self._acquire()
+    def _dispatch_on(self, eid: int, obs: Any, mask: Any, stall,
+                     n: int) -> "tuple[Any, int]":
+        """One booked dispatch on engine ``eid`` (inflight slot already
+        acquired; always released). The fault injector is consulted with
+        a fresh router-global sequence number right before device work."""
+        with self._lock:
+            seq = self._dispatch_seq
+            self._dispatch_seq += 1
         bucket = None
         try:
             with self._device_lock:
+                if self._injector is not None:
+                    self._injector.on_dispatch(eid, seq)
                 actions, bucket = self.engines[eid].decide(obs, mask, stall)
         finally:
             self._release(eid, n, bucket)
         return actions, bucket
+
+    def _note_success(self, eid: int) -> None:
+        with self._lock:
+            self._consec_fail[eid] = 0
+
+    def _note_failure(self, eid: int, exc: BaseException) -> None:
+        """Record one dispatch failure; eject the engine once it hits
+        ``eject_after`` CONSECUTIVE failures (one transient error never
+        drains capacity). Ejection arms the exponential-backoff re-probe
+        and is loud: bus event, per-engine counter, lane instant."""
+        fields = None
+        with self._lock:
+            self._eng_failures[eid].inc()
+            self._consec_fail[eid] += 1
+            if (not self._ejected[eid]
+                    and self._consec_fail[eid] >= self.eject_after):
+                self._ejected[eid] = True
+                backoff = self._backoff[eid]
+                self._eject_until[eid] = self._clock() + backoff
+                self._backoff[eid] = min(backoff * 2,
+                                         self.probe_backoff_max_s)
+                self._eng_ejections[eid].inc()
+                self._g_ejected.set(sum(self._ejected))
+                fields = dict(engine=eid,
+                              consecutive_failures=self._consec_fail[eid],
+                              backoff_s=backoff,
+                              error=type(exc).__name__)
+        if fields is not None:
+            if self._bus is not None:
+                self._bus.emit("engine_eject", **fields)
+            self.engines[eid].tracer.instant("eject", **fields)
+
+    def _probe(self, eid: int) -> bool:
+        """Re-probe an ejected engine: blessed re-warm (idempotent — a
+        warm engine's buckets are remembered) then ONE real 1-row
+        dispatch through the fault injector, straight on the engine so
+        probe rows never pollute the routing row accounting. True =
+        healthy, readmit."""
+        if self._example is None:
+            return True        # nothing to probe with; trust the retry
+        obs = stack_requests([self._example[0]])
+        mask = stack_requests([self._example[1]])
+        try:
+            with self.engines[eid].tracer.span("rewarm_probe"):
+                with self._lock:
+                    seq = self._dispatch_seq
+                    self._dispatch_seq += 1
+                with self._device_lock:
+                    if self._injector is not None:
+                        self._injector.on_dispatch(eid, seq)
+                    self.engines[eid].warmup(*self._example)
+                    self.engines[eid].decide(obs, mask, None)
+            return True
+        except Exception:
+            with self._lock:
+                self._eng_failures[eid].inc()
+            return False
+
+    def _maybe_readmit(self) -> None:
+        """Give every ejected engine whose backoff has elapsed one
+        re-probe; readmit on success (reset failure streak + backoff),
+        push the next probe out exponentially on failure. Called at
+        decide time — probes ride the request stream, no extra thread."""
+        with self._lock:
+            if not any(self._ejected):
+                return
+            now = self._clock()
+            due = [i for i in range(len(self.engines))
+                   if self._ejected[i] and not self._probing[i]
+                   and now >= self._eject_until[i]]
+            for i in due:
+                self._probing[i] = True
+        for i in due:
+            ok = self._probe(i)
+            with self._lock:
+                self._probing[i] = False
+                if ok:
+                    self._ejected[i] = False
+                    self._consec_fail[i] = 0
+                    self._backoff[i] = self.probe_backoff_s
+                    self._eng_readmissions[i].inc()
+                    self._g_ejected.set(sum(self._ejected))
+                else:
+                    self._eject_until[i] = (self._clock()
+                                            + self._backoff[i])
+                    self._backoff[i] = min(self._backoff[i] * 2,
+                                           self.probe_backoff_max_s)
+            if ok:
+                if self._bus is not None:
+                    self._bus.emit("engine_readmit", engine=i)
+                self.engines[i].tracer.instant("readmit")
+
+    def decide(self, obs: Any, mask: Any, stall=None) -> "tuple[Any, int]":
+        """One routed batch decision — same signature and result as
+        :meth:`.engine.InferenceEngine.decide` (bit-identical, per the
+        module-docstring contract).
+
+        Failure path (the PR-13 no-silent-drop invariant through engine
+        loss): a failed dispatch is retried ONCE on a different healthy
+        engine (bounded hedge, counted in ``serve_retry_hedges_total``);
+        if the retry fails too — or no healthy engine remains — the
+        exception propagates, and the batching layer resolves every
+        affected future with it. Nothing is ever dropped silently."""
+        n = int(jax.tree.leaves(obs)[0].shape[0])
+        self._maybe_readmit()
+        eid = self._acquire()
+        try:
+            out = self._dispatch_on(eid, obs, mask, stall, n)
+        except Exception as first:
+            self._note_failure(eid, first)
+            try:
+                retry_eid = self._acquire(exclude=eid)
+            except RuntimeError:
+                raise first
+            self._retries.inc()
+            if self._bus is not None:
+                self._bus.emit("serve_retry", from_engine=eid,
+                               to_engine=retry_eid,
+                               error=type(first).__name__)
+            try:
+                with self.engines[retry_eid].tracer.span(
+                        "retry_hedge", from_engine=eid):
+                    out = self._dispatch_on(retry_eid, obs, mask, stall, n)
+            except Exception as second:
+                self._note_failure(retry_eid, second)
+                raise
+            self._note_success(retry_eid)
+            return out
+        self._note_success(eid)
+        return out
 
     # ---- warmup / live resize ----------------------------------------
 
@@ -286,8 +581,23 @@ class EngineRouter:
                 dispatches=self._dispatch_counts[i],
                 rows=self._rows[i],
                 slots=self._slots[i],
-                recompiles=self.engines[i].post_warmup_recompiles)
+                recompiles=self.engines[i].post_warmup_recompiles,
+                ejected=self._ejected[i],
+                consecutive_failures=self._consec_fail[i])
                 for i in range(len(self.engines))]
+
+    def fault_stats(self) -> dict:
+        """Fleet-aggregate health numbers for bench/soak reports."""
+        with self._lock:
+            return {
+                "failures": int(sum(c.value for c in self._eng_failures)),
+                "ejections": int(sum(c.value
+                                     for c in self._eng_ejections)),
+                "readmissions": int(sum(c.value
+                                        for c in self._eng_readmissions)),
+                "retry_hedges": int(self._retries.value),
+                "engines_ejected": int(sum(self._ejected)),
+            }
 
 
 class AutoscaleAdvisor:

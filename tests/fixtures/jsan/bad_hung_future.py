@@ -1,0 +1,20 @@
+"""Known-bad: unbounded waits a dead resolver turns into silent hangs (3 findings)."""
+import queue
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._t = threading.Thread(target=self._pump_loop, daemon=True)
+
+    def _pump_loop(self):
+        while True:
+            fut = self._q.get()                          # finding
+            fut.set_result(None)
+
+    def wait(self, fut):
+        return fut.result()                              # finding
+
+    def first(self):
+        return self._q.get()                             # finding

@@ -1,0 +1,26 @@
+"""Known-good: every wait bounded, non-blocking, or off the queue path."""
+import queue
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._t = threading.Thread(target=self._pump_loop, daemon=True)
+
+    def _pump_loop(self):
+        while True:
+            try:
+                fut = self._q.get(timeout=0.5)           # bounded
+            except queue.Empty:
+                return
+            fut.set_result(None)
+
+    def wait(self, fut):
+        return fut.result(timeout=30.0)                  # bounded
+
+    def poll(self):
+        return self._q.get_nowait()                      # non-blocking
+
+    def label(self, parts):
+        return ",".join(parts)                           # not a queue

@@ -38,6 +38,7 @@ BAD = {
     "donation-cross-thread": ("bad_donation_cross_thread.py", 1),
     "shared-state-unlocked": ("bad_shared_state_unlocked.py", 2),
     "blocking-under-lock": ("bad_blocking_under_lock.py", 3),
+    "hung-future": ("bad_hung_future.py", 3),
     "refusal-drift": (os.path.join("refusal_bad", "train.py"), 2),
 }
 GOOD = ["good_donation.py", "good_host_sync.py", "good_tracer_leak.py",
@@ -49,6 +50,7 @@ GOOD = ["good_donation.py", "good_host_sync.py", "good_tracer_leak.py",
         "good_donation_cross_thread.py",
         "good_shared_state_unlocked.py",
         "good_blocking_under_lock.py",
+        "good_hung_future.py",
         os.path.join("refusal_good", "configs.py"),
         os.path.join("refusal_good", "train.py")]
 

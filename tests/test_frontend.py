@@ -1,0 +1,202 @@
+"""HTTP frontend tests (ISSUE 16): the wire contract of the serving
+front door — zero-copy decide path, deadline propagation to a 503 +
+``Retry-After`` derived from the LEARNED service-time Ewma (a cold
+server admits instead of guessing), malformed-input 400s, queue-depth
+connection backpressure, and the graceful-drain contract (late submits
+get a typed :class:`ServerClosedError`, never a hung future)."""
+import contextlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rlgpuschedule_tpu.obs import Registry
+from rlgpuschedule_tpu.serve import (PolicyServer, ServerClosedError,
+                                     next_bucket, start_frontend)
+from rlgpuschedule_tpu.serve.frontend import DECIDE_PATH, HEALTH_PATH
+
+OBS_D, ACT_D = 6, 9
+
+
+class HostEngine:
+    """Host-only engine stand-in: argmax over the observation row, an
+    optional real sleep per dispatch so the service-time Ewma learns a
+    controllable value."""
+
+    def __init__(self, max_bucket=8, cost_s=0.0):
+        self.max_bucket = max_bucket
+        self.cost_s = cost_s
+
+    def bucket_for(self, n):
+        return next_bucket(n, self.max_bucket)
+
+    def decide(self, obs, mask, stall=None):
+        if self.cost_s:
+            time.sleep(self.cost_s)
+        n = int(np.asarray(obs).shape[0])
+        return (np.argmax(np.asarray(obs), axis=-1).astype(np.int32),
+                self.bucket_for(n))
+
+
+def example(seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(OBS_D).astype(np.float32),
+            np.ones(ACT_D, bool))
+
+
+@contextlib.contextmanager
+def serving_stack(cost_s=0.0, max_bucket=8, **fe_kw):
+    reg = Registry()
+    server = PolicyServer(HostEngine(max_bucket, cost_s), registry=reg)
+    server.start()
+    obs, mask = example()
+    handle = start_frontend(server, obs, mask, port=0, **fe_kw)
+    try:
+        yield handle, server, reg, obs, mask
+    finally:
+        handle.close()
+
+
+def post(url, body, headers=None, timeout=30):
+    req = urllib.request.Request(url, data=body, method="POST",
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestDecidePath:
+    def test_decide_200_round_trip(self):
+        with serving_stack() as (handle, server, reg, obs, mask):
+            status, headers, payload = post(
+                handle.url + DECIDE_PATH, obs.tobytes() + mask.tobytes())
+            assert status == 200
+            assert payload["action"] == int(np.argmax(obs))
+            assert payload["latency_ms"] >= 0
+            assert reg.counter("serve_frontend_requests_total").value == 1
+
+    def test_healthz_and_unknown_route(self):
+        with serving_stack() as (handle, *_):
+            status, payload = get(handle.url + HEALTH_PATH)
+            assert status == 200
+            assert payload["status"] == "ok"
+            assert payload["queue_depth"] == 0
+            status, _, payload = post(handle.url + "/nope", b"")
+            assert status == 404 and payload["error"] == "unknown route"
+
+    def test_wrong_length_body_is_400_with_expected_bytes(self):
+        with serving_stack() as (handle, server, reg, obs, mask):
+            want = obs.nbytes + mask.nbytes
+            status, _, payload = post(handle.url + DECIDE_PATH, b"x" * 3)
+            assert status == 400
+            assert f"{want} bytes" in payload["detail"]
+            assert reg.counter(
+                "serve_frontend_bad_requests_total").value == 1
+
+    def test_bad_deadline_header_is_400(self):
+        with serving_stack() as (handle, server, reg, obs, mask):
+            body = obs.tobytes() + mask.tobytes()
+            for bad in ("junk", "nan", "inf", "-5", "0"):
+                status, _, payload = post(
+                    handle.url + DECIDE_PATH, body,
+                    headers={"X-Deadline-Ms": bad})
+                assert status == 400, bad
+                assert "X-Deadline-Ms" in payload["detail"]
+
+
+class TestShedMapping:
+    """The satellite contract: wire deadline -> 503 with a finite,
+    positive ``Retry-After`` derived from the learned Ewma; a COLD
+    server (no service-time observation yet) admits instead."""
+
+    def test_cold_server_admits_deadlined_request(self):
+        with serving_stack(cost_s=0.0) as (handle, server, reg, obs, mask):
+            assert server.service_time_s() is None      # nothing learned
+            status, _, payload = post(
+                handle.url + DECIDE_PATH, obs.tobytes() + mask.tobytes(),
+                headers={"X-Deadline-Ms": "1"})
+            assert status == 200
+            assert payload["action"] == int(np.argmax(obs))
+            assert reg.counter("serve_frontend_shed_total").value == 0
+
+    def test_shed_503_retry_after_from_learned_ewma(self):
+        with serving_stack(cost_s=0.05, max_bucket=1) as (
+                handle, server, reg, obs, mask):
+            body = obs.tobytes() + mask.tobytes()
+            status, _, _ = post(handle.url + DECIDE_PATH, body)
+            assert status == 200                        # learns svc
+            svc = server.service_time_s()
+            assert svc is not None and svc > 0
+            status, headers, payload = post(
+                handle.url + DECIDE_PATH, body,
+                headers={"X-Deadline-Ms": "1"})
+            assert status == 503
+            assert payload["error"] == "shed"
+            assert payload["reason"] == "admission"
+            assert payload["deadline_ms"] == pytest.approx(1.0)
+            retry = float(headers["Retry-After"])
+            assert np.isfinite(retry) and retry > 0
+            assert retry == pytest.approx(payload["retry_after_s"],
+                                          abs=1e-3)
+            # one learned service time + the predicted excess wait
+            # (queue empty at admission: predicted == one svc)
+            assert payload["retry_after_s"] == pytest.approx(
+                svc + max(svc - 1e-3, 0.0), rel=1e-6)
+            assert reg.counter("serve_frontend_shed_total").value == 1
+            assert reg.counter("serve_shed_total").value == 1
+
+
+class TestBackpressure:
+    def test_high_water_pauses_reads_and_all_requests_resolve(self):
+        with serving_stack(cost_s=0.02, max_bucket=1, high_water=2,
+                           low_water=1) as (handle, server, reg, obs,
+                                            mask):
+            body = obs.tobytes() + mask.tobytes()
+            results = []
+
+            def one():
+                results.append(post(handle.url + DECIDE_PATH, body)[0])
+
+            threads = [threading.Thread(target=one) for _ in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert results == [200] * 12
+            assert reg.counter(
+                "serve_frontend_backpressure_pauses_total").value >= 1
+
+
+class TestDrain:
+    def test_drain_refuses_late_work_typed(self):
+        with serving_stack() as (handle, server, reg, obs, mask):
+            body = obs.tobytes() + mask.tobytes()
+            assert post(handle.url + DECIDE_PATH, body)[0] == 200
+            handle.drain()
+            assert server.closed
+            # a straggler submit gets the typed refusal, never a future
+            # no dispatcher will resolve
+            with pytest.raises(ServerClosedError):
+                server.submit(obs, mask)
+            # and the listener is gone: connect refused, not a hang
+            with pytest.raises((urllib.error.URLError, ConnectionError,
+                                OSError)):
+                post(handle.url + DECIDE_PATH, body, timeout=5)
+            handle.drain()                              # idempotent
+
+    def test_frontend_counts_draining_rejections(self):
+        with serving_stack() as (handle, server, reg, obs, mask):
+            handle.drain()
+            assert reg.counter("serve_frontend_closed_total").value == 0
+            assert handle.frontend.draining

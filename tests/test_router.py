@@ -13,7 +13,10 @@ from rlgpuschedule_tpu.obs import Registry
 from rlgpuschedule_tpu.parallel.mesh import serve_devices
 from rlgpuschedule_tpu.serve import (AutoscaleAdvisor, DeadlineSheddedError,
                                      EngineRouter, Ewma, InferenceEngine,
-                                     PolicyServer, ServeResult, next_bucket)
+                                     InjectedEngineFault, PolicyServer,
+                                     ServeFaultInjector, ServeFaultSpec,
+                                     ServeResult, ServerClosedError,
+                                     next_bucket, parse_serve_fault)
 
 OBS_D, ACT_D = 6, 9
 
@@ -478,3 +481,346 @@ class TestAutoscaleHysteresis:
             AutoscaleAdvisor(Registry(), n_max=0)
         with pytest.raises(ValueError, match="hysteresis"):
             AutoscaleAdvisor(Registry(), n_max=2, hysteresis=0)
+
+
+# ---- ISSUE 16: engine fault tolerance ---------------------------------
+
+class _Bus:
+    """Event-bus stand-in recording (kind, fields) tuples."""
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **fields):
+        self.events.append((kind, fields))
+
+    def kinds(self):
+        return [k for k, _ in self.events]
+
+
+def health_router(specs, injector_kw=None, bus=None, **kw):
+    """2-engine router with a fake monotonic clock (cell-advanced) and
+    an armed fault injector, for deterministic ejection/backoff tests."""
+    now = [100.0]
+    inj = ServeFaultInjector(specs, bus=bus, **(injector_kw or {}))
+    router = make_router(registry=Registry(), fault_injector=inj, bus=bus,
+                         probe_backoff_s=0.5, clock=lambda: now[0], **kw)
+    return router, now
+
+
+class TestServeFaultSpecs:
+    def test_parse_round_trip(self):
+        s = parse_serve_fault("engine-hang@10:engine=1")
+        assert (s.kind, s.at, s.engine, s.fired) == \
+            ("engine-hang", 10, 1, False)
+        assert parse_serve_fault(" engine-raise@3 ").engine == 0
+
+    @pytest.mark.parametrize("bad", [
+        "engine-raise", "nope@3", "engine-raise@x",
+        "engine-raise@3:rank=1", "engine-raise@3:engine=x"])
+    def test_parse_rejects_with_the_offending_spec(self, bad):
+        with pytest.raises(ValueError, match="serve-fault"):
+            parse_serve_fault(bad)
+
+    def test_ge_semantics_fire_exactly_once(self):
+        """A spec fires on the FIRST dispatch with seq >= at landing on
+        its engine (exact-match would lose the race to the other pump
+        thread forever), and never again."""
+        inj = ServeFaultInjector([ServeFaultSpec("engine-raise", at=2,
+                                                 engine=1)])
+        inj.on_dispatch(1, 0)                   # below at: no-op
+        inj.on_dispatch(0, 5)                   # wrong engine: no-op
+        with pytest.raises(InjectedEngineFault):
+            inj.on_dispatch(1, 5)               # >= at: fires
+        inj.on_dispatch(1, 6)                   # spent: no-op
+        assert inj.specs[0].fired
+
+    def test_slow_returns_hang_raises(self):
+        inj = ServeFaultInjector(
+            [ServeFaultSpec("engine-slow", at=0),
+             ServeFaultSpec("engine-hang", at=1)],
+            slow_s=0.0, hang_s=0.0)
+        inj.on_dispatch(0, 0)                   # brownout: succeeds
+        with pytest.raises(InjectedEngineFault, match="hung"):
+            inj.on_dispatch(0, 1)
+
+
+class TestEngineHealth:
+    def test_consecutive_failures_eject_then_backoff_readmits(self):
+        router, now = health_router(
+            [ServeFaultSpec("engine-raise", at=0),
+             ServeFaultSpec("engine-raise", at=0)])
+        rng = np.random.default_rng(20)
+        obs, mask = make_batch(rng, 4)
+        router.warmup(obs[0], mask[0])
+        router.decide(obs, mask)        # fail 1 on engine 0 -> hedge
+        router.decide(obs, mask)        # fail 2 -> EJECT -> hedge
+        fs = router.fault_stats()
+        assert fs == {"failures": 2, "ejections": 1, "readmissions": 0,
+                      "retry_hedges": 2, "engines_ejected": 1}
+        st = router.stats()
+        assert st[0].ejected and not st[1].ejected
+        assert st[0].consecutive_failures == 2
+        router.decide(obs, mask)        # backoff not elapsed: no probe
+        assert router.stats()[0].dispatches == 0
+        now[0] += 1.0                   # past the 0.5s backoff
+        router.decide(obs, mask)        # probe passes -> readmitted
+        fs = router.fault_stats()
+        assert fs["readmissions"] == 1 and fs["engines_ejected"] == 0
+        st = router.stats()
+        assert not st[0].ejected and st[0].consecutive_failures == 0
+        assert st[0].dispatches >= 1    # taking traffic again
+        assert router.per_engine_recompiles() == [0, 0]
+
+    def test_single_transient_failure_never_ejects(self):
+        router, _ = health_router([ServeFaultSpec("engine-raise", at=0)])
+        rng = np.random.default_rng(21)
+        obs, mask = make_batch(rng, 2)
+        router.warmup(obs[0], mask[0])
+        a, b = router.decide(obs, mask)         # hedged transparently
+        assert np.asarray(a).shape[0] == 2 and b == 2
+        router.decide(obs, mask)                # success resets streak
+        fs = router.fault_stats()
+        assert fs["failures"] == 1 and fs["ejections"] == 0
+        assert all(s.consecutive_failures == 0 for s in router.stats())
+
+    def test_slow_engine_is_not_ejected(self):
+        """Brownout discipline: a slow dispatch SUCCEEDS — health
+        tracking must not drain capacity over latency alone."""
+        router, _ = health_router([ServeFaultSpec("engine-slow", at=0)],
+                                  injector_kw={"slow_s": 0.0})
+        rng = np.random.default_rng(22)
+        obs, mask = make_batch(rng, 2)
+        router.warmup(obs[0], mask[0])
+        router.decide(obs, mask)
+        fs = router.fault_stats()
+        assert fs["failures"] == 0 and fs["retry_hedges"] == 0
+
+    def test_failed_probe_doubles_backoff_until_fault_clears(self):
+        router, now = health_router(
+            [ServeFaultSpec("engine-raise", at=0),
+             ServeFaultSpec("engine-raise", at=0),
+             ServeFaultSpec("engine-raise", at=0)])
+        rng = np.random.default_rng(23)
+        obs, mask = make_batch(rng, 4)
+        router.warmup(obs[0], mask[0])
+        router.decide(obs, mask)        # fail 1
+        router.decide(obs, mask)        # fail 2 -> eject, probe at +0.5
+        now[0] += 0.6
+        router.decide(obs, mask)        # probe fires spec 3 -> FAILS
+        fs = router.fault_stats()
+        assert fs["failures"] == 3 and fs["readmissions"] == 0
+        assert router.stats()[0].ejected
+        now[0] += 0.5                   # inside the DOUBLED (1s) backoff
+        router.decide(obs, mask)
+        assert router.fault_stats()["readmissions"] == 0
+        now[0] += 1.0                   # past it; fault set exhausted
+        router.decide(obs, mask)
+        fs = router.fault_stats()
+        assert fs["readmissions"] == 1 and fs["engines_ejected"] == 0
+
+    def test_total_engine_loss_raises_then_recovers(self):
+        router, now = health_router(
+            [ServeFaultSpec("engine-raise", at=0, engine=0),
+             ServeFaultSpec("engine-raise", at=0, engine=1)],
+            eject_after=1)
+        rng = np.random.default_rng(24)
+        obs, mask = make_batch(rng, 2)
+        router.warmup(obs[0], mask[0])
+        with pytest.raises(InjectedEngineFault):
+            router.decide(obs, mask)    # both engines eject, loudly
+        fs = router.fault_stats()
+        assert fs["engines_ejected"] == 2 and fs["retry_hedges"] == 1
+        with pytest.raises(RuntimeError, match="no active healthy"):
+            router.decide(obs, mask)    # nothing to serve with
+        now[0] += 1.0                   # probes pass (faults spent)
+        a, b = router.decide(obs, mask)
+        assert b == 2
+        assert router.fault_stats()["readmissions"] == 2
+
+    def test_lifecycle_lands_on_the_event_bus(self):
+        bus = _Bus()
+        router, now = health_router(
+            [ServeFaultSpec("engine-raise", at=0),
+             ServeFaultSpec("engine-raise", at=0)], bus=bus)
+        rng = np.random.default_rng(25)
+        obs, mask = make_batch(rng, 2)
+        router.warmup(obs[0], mask[0])
+        router.decide(obs, mask)
+        router.decide(obs, mask)
+        now[0] += 1.0
+        router.decide(obs, mask)
+        kinds = bus.kinds()
+        for want in ("serve_fault", "serve_retry", "engine_eject",
+                     "engine_readmit"):
+            assert want in kinds, kinds
+        eject = dict(bus.events)["engine_eject"]
+        assert eject["engine"] == 0
+        assert eject["consecutive_failures"] == 2
+        assert eject["error"] == "InjectedEngineFault"
+
+    def test_hedged_batch_is_bit_identical_to_healthy_fleet(self):
+        """The retry hedge must not change ANSWERS: a faulted fleet's
+        output equals a healthy single engine's for the same rows."""
+        router, _ = health_router([ServeFaultSpec("engine-raise", at=0)])
+        single = InferenceEngine(linear_apply, make_params(),
+                                 max_bucket=8, registry=Registry(),
+                                 stall_gate=False)
+        rng = np.random.default_rng(26)
+        obs, mask = make_batch(rng, 4)
+        router.warmup(obs[0], mask[0])
+        single.warmup(obs[0], mask[0])
+        a_r, b_r = router.decide(obs, mask)     # served via the hedge
+        a_s, b_s = single.decide(obs, mask)
+        assert b_r == b_s
+        assert np.array_equal(np.asarray(a_r), np.asarray(a_s))
+
+
+# ---- ISSUE 16: drain contract + exactly-once shed accounting ----------
+
+class TestServerClosed:
+    def test_close_refuses_submit_and_start_forever(self):
+        server, t, reg = fake_server()
+        rng = np.random.default_rng(30)
+        fut = server.submit(*row(rng))
+        server.close()
+        assert isinstance(fut.result(timeout=10), ServeResult), \
+            "close() must flush already-accepted work"
+        assert server.closed
+        with pytest.raises(ServerClosedError, match="closed"):
+            server.submit(*row(rng))
+        with pytest.raises(ServerClosedError):
+            server.start()
+        server.close()                          # idempotent
+
+    def test_stop_is_not_terminal_close_is(self):
+        server, t, reg = fake_server()
+        rng = np.random.default_rng(31)
+        server.start()
+        server.stop()
+        fut = server.submit(*row(rng))          # back in inline mode
+        assert server.pump() == 1
+        assert isinstance(fut.result(timeout=10), ServeResult)
+        server.close()
+        with pytest.raises(ServerClosedError):
+            server.submit(*row(rng))
+
+    def test_submit_refused_while_drain_in_flight(self):
+        server, t, reg = fake_server()
+        rng = np.random.default_rng(32)
+        with server._wake:                      # freeze mid-drain state
+            server._stopped = True
+        with pytest.raises(ServerClosedError, match="drain in flight"):
+            server.submit(*row(rng))
+        with server._wake:
+            server._stopped = False
+        server.submit(*row(rng))
+        assert server.pump() == 1
+
+    def test_close_resolves_queued_futures_even_on_engine_failure(self):
+        class DeadEngine:
+            max_bucket = 8
+
+            def bucket_for(self, n):
+                return next_bucket(n, 8)
+
+            def decide(self, obs, mask, stall=None):
+                raise RuntimeError("device lost")
+
+        reg = Registry()
+        server = PolicyServer(DeadEngine(), registry=reg)
+        rng = np.random.default_rng(33)
+        futs = [server.submit(*row(rng)) for _ in range(3)]
+        server.close()                          # must not hang or strand
+        for f in futs:
+            with pytest.raises(RuntimeError, match="device lost"):
+                f.result(timeout=10)
+        assert reg.counter("serve_dispatch_errors_total").value == 1
+
+
+class TestShedAccounting:
+    def test_cancelled_future_is_not_counted_as_shed(self):
+        """The exactly-once invariant: a client that walked away
+        (Future.cancel) is not double-counted by the expiry scan —
+        ``serve_shed_total`` counts only rejections someone can SEE."""
+        server, t, reg = fake_server()
+        rng = np.random.default_rng(34)
+        fut = server.submit(*row(rng), deadline_s=0.5)
+        assert fut.cancel()
+        t[0] += 1.0
+        assert server.pump() == 0               # expiry scan drops it
+        assert reg.counter("serve_shed_total").value == 0
+
+    def test_multi_dispatcher_shed_counted_exactly_once(self):
+        """4 dispatcher threads race the same expiry scans and admission
+        path under real time; conservation must hold exactly:
+        submitted == served + shed, and the counter == typed
+        rejections observed (no double-count, no silent drop)."""
+        import time as _time
+
+        class SleepyEngine:
+            max_bucket = 1
+
+            def bucket_for(self, n):
+                return next_bucket(n, 1)
+
+            def decide(self, obs, mask, stall=None):
+                _time.sleep(0.002)
+                return np.asarray(obs), 1
+
+        reg = Registry()
+        server = PolicyServer(SleepyEngine(), registry=reg)
+        rng = np.random.default_rng(35)
+        o, m = row(rng)
+        server.start(dispatchers=4)
+        try:
+            futs = [server.submit(o, m, deadline_s=0.004)
+                    for _ in range(120)]
+        finally:
+            server.stop()                       # drains before stopping
+        served = shed = 0
+        for f in futs:
+            try:
+                assert isinstance(f.result(timeout=30), ServeResult)
+                served += 1
+            except DeadlineSheddedError:
+                shed += 1
+        assert served + shed == len(futs) == 120
+        assert reg.counter("serve_shed_total").value == shed
+        assert reg.counter("serve_requests_total").value == 120
+        assert shed > 0, "the race was never exercised"
+
+
+class TestDispatcherSurvival:
+    def test_dispatcher_outlives_a_failed_dispatch(self):
+        """A pump exception resolves ITS batch exceptionally and the
+        dispatcher keeps serving — a dead dispatcher would strand every
+        later request as a hung future."""
+        class FlakyEngine:
+            max_bucket = 1
+
+            def __init__(self):
+                self.fails_left = 1
+
+            def bucket_for(self, n):
+                return next_bucket(n, 1)
+
+            def decide(self, obs, mask, stall=None):
+                if self.fails_left:
+                    self.fails_left -= 1
+                    raise RuntimeError("transient XLA error")
+                return np.asarray(obs), 1
+
+        reg = Registry()
+        server = PolicyServer(FlakyEngine(), registry=reg)
+        rng = np.random.default_rng(36)
+        server.start()
+        try:
+            f1 = server.submit(*row(rng))
+            with pytest.raises(RuntimeError, match="transient"):
+                f1.result(timeout=30)
+            f2 = server.submit(*row(rng))       # same dispatcher thread
+            assert isinstance(f2.result(timeout=30), ServeResult)
+        finally:
+            server.stop()
+        assert reg.counter("serve_dispatch_errors_total").value == 1
