@@ -17,8 +17,8 @@ class Rule:
 
 
 def all_rules() -> list[Rule]:
-    from . import (blocking_under_lock, compile_off_thread,
-                   device_dispatch_unlocked, donation,
+    from . import (alloc_in_hot_loop, blocking_under_lock,
+                   compile_off_thread, device_dispatch_unlocked, donation,
                    donation_cross_thread, host_sync, hung_future,
                    impure_in_jit, prng_reuse, recompile, refusal_drift,
                    shared_state_unlocked, sync_in_loop, tracer_leak,
@@ -29,7 +29,7 @@ def all_rules() -> list[Rule]:
             compile_off_thread.RULE, device_dispatch_unlocked.RULE,
             donation_cross_thread.RULE, shared_state_unlocked.RULE,
             blocking_under_lock.RULE, hung_future.RULE,
-            refusal_drift.RULE]
+            alloc_in_hot_loop.RULE, refusal_drift.RULE]
 
 
 def rule_names() -> list[str]:

@@ -30,19 +30,26 @@ one-at-a-time evaluation.
   streams, zero-recompile steady-state assertion; ``run_chaos_soak``
   paces the fitted trace arrival process through a fleet under
   injected engine faults and reports the conservation invariant.
-- :mod:`.frontend` — the network front door (PR 16):
-  :class:`ServeFrontend`, an asyncio HTTP listener with zero-copy
-  request decoding, wire deadline propagation (503 + learned
-  ``Retry-After`` on shed), queue-depth connection backpressure, and
-  graceful SIGTERM drain (typed :class:`ServerClosedError` for late
-  submits — never a hung future).
+- :mod:`.frontend` — the network front door (PR 16, rebuilt PR 17):
+  :class:`ServeFrontend`, an asyncio listener speaking keep-alive
+  HTTP/1.1 *and* the length-prefixed binary frame dialect
+  (:mod:`.wire`) on one port, with zero-copy request decoding, wire
+  deadline propagation (503 + learned clamped ``Retry-After`` on
+  shed), queue-depth connection backpressure, and graceful SIGTERM
+  drain (typed :class:`ServerClosedError` for late submits — never a
+  hung future).
+- :mod:`.wire` — the framed transport: 24-byte prefix (magic,
+  version, kind, lengths, metadata) + dtype/shape descriptor header +
+  raw row bytes; ``np.frombuffer`` is the only decode.
 - ``python -m rlgpuschedule_tpu.serve`` — the CLI (``--bench``,
   ``--fleet N``, ``--metrics-port`` live Prometheus scrape endpoint,
   ``--chaos-faults`` engine-fault chaos soak, ``--frontend-port``).
 """
+from . import wire
 from .batching import (DeadlineSheddedError, Ewma, PolicyServer, Reservoir,
                        ServeResult, ServerClosedError, next_bucket,
                        pad_batch, scatter_results, stack_requests)
+from .bench import StubEngine, run_host_path
 from .engine import InferenceEngine
 from .fleet import fleet_replay, fleet_windows, sample_fleet_faults
 from .frontend import FrontendHandle, ServeFrontend, start_frontend
@@ -56,7 +63,8 @@ __all__ = [
     "EngineRouter", "AutoscaleAdvisor", "EngineStats",
     "SERVE_FAULT_KINDS", "ServeFaultSpec", "ServeFaultInjector",
     "InjectedEngineFault", "parse_serve_fault",
-    "ServeFrontend", "FrontendHandle", "start_frontend",
+    "ServeFrontend", "FrontendHandle", "start_frontend", "wire",
+    "StubEngine", "run_host_path",
     "next_bucket", "pad_batch", "scatter_results", "stack_requests",
     "fleet_replay", "fleet_windows", "sample_fleet_faults",
 ]

@@ -13,8 +13,31 @@ front end's whole job is managing that axis on the host side:
   produce ``-inf``-everywhere logits or NaNs, its action is discarded
   anyway);
 - **scatter**: the batched action array is split back to the submitting
-  requests in FIFO order (``scatter_results`` — the padding+scatter
-  round-trip is property-tested in tests/test_serve.py).
+  requests in FIFO order.
+
+Since ISSUE 17 the hot path is the **arena data plane**
+(``data_plane="arena"``, the default): requests land directly in
+preallocated bucket-sized slabs (one memcpy from the wire bytes into
+the slot row — ``submit`` IS the stack), ``pump`` seals a slab in place
+(tail rows neutralized by slice assignment, no ``np.concatenate``) and
+dispatches a contiguous view, and ``scatter`` hands back views into the
+single device-fetched actions buffer. Steady state allocates ZERO new
+host ndarrays per batch (asserted by test; ``serve_arena_allocs_total``
+counts slab allocations and must stay flat after warmup). The handoff
+is **lock-light**: producers take one tiny O(1) critical section to
+reserve a sequence-numbered slot (CPython's GIL rules out a true CAS
+loop, so "lock-free reservation" is not expressible — the honest
+version is a lock held for a handful of bytecodes, never across a copy
+or a dispatch), the row memcpy and the publish flag happen outside any
+lock, and the consumer side never holds the producers' lock during its
+O(batch) stacking/accounting work (the legacy plane shared ONE lock for
+all of that).
+
+The pre-arena plane survives as ``data_plane="legacy"`` — the measured
+"before" arm of ``serve.bench.run_host_path`` (BENCH_r09) and a
+fallback — via ``stack_requests``/``pad_batch``, which also remain the
+public padding utilities for non-hot-path callers (router probes,
+engine warmup).
 
 Everything operates on HOST pytrees (numpy leaves, leading request
 axis); device placement is the engine's job, so the queue never holds
@@ -95,10 +118,40 @@ def next_bucket(n: int, max_bucket: int) -> int:
 
 def stack_requests(rows: "list[Any]") -> Any:
     """Stack per-request pytrees (no leading axis) into one batched host
-    pytree (leading axis = len(rows), FIFO order preserved)."""
+    pytree (leading axis = len(rows), FIFO order preserved). Legacy-
+    plane / probe utility: the arena plane never stacks — rows are
+    written into the slab at submit time."""
     import jax
-    return jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]),
-                        *rows)
+
+    def stack(*xs):
+        # jsan: disable=alloc-in-hot-loop -- legacy data plane (the bench
+        # before-arm) and rare router probes; the arena plane never stacks
+        return np.stack([np.asarray(x) for x in xs])
+
+    return jax.tree.map(stack, *rows)
+
+
+# Padding fill constants, hoisted out of the per-call path (ISSUE 17
+# satellite): keyed by (pad rows, row tail shape, dtype, mask fill), so
+# the bool-mask-pads-True / everything-else-pads-zero branch and the
+# constant construction happen ONCE per bucket shape instead of per
+# call, and the fill dtype is the leaf dtype by construction — padding
+# can never promote (pinned by a dtype-stability test). The cache is
+# bounded by the number of distinct (bucket, leaf) shapes a process
+# serves — a handful.
+_PAD_FILL_CACHE: "dict[tuple, np.ndarray]" = {}
+
+
+def _pad_fill(rows: int, tail: tuple, dtype: np.dtype,
+              mask_true: bool) -> np.ndarray:
+    key = (rows, tail, dtype, bool(mask_true))
+    fill = _PAD_FILL_CACHE.get(key)
+    if fill is None:
+        value = True if (mask_true and dtype == np.bool_) else 0
+        fill = np.full((rows,) + tail, value, dtype)
+        fill.setflags(write=False)      # shared across batches: immutable
+        _PAD_FILL_CACHE[key] = fill
+    return fill
 
 
 def pad_batch(batch: Any, bucket: int, fill_mask_true: bool = False) -> Any:
@@ -108,7 +161,9 @@ def pad_batch(batch: Any, bucket: int, fill_mask_true: bool = False) -> Any:
     ``fill_mask_true``: action masks pad with every action legal, so the
     padded rows' logits stay finite under the ``-inf`` masking scheme
     (an all-masked row is the degenerate case the models never see in
-    training)."""
+    training). A full bucket (n == bucket) returns the input unchanged —
+    the arena plane relies on this no-op to dispatch slab views without
+    a copy."""
     import jax
 
     def pad(x):
@@ -118,10 +173,10 @@ def pad_batch(batch: Any, bucket: int, fill_mask_true: bool = False) -> Any:
             raise ValueError(f"batch of {n} rows exceeds bucket {bucket}")
         if n == bucket:
             return x
-        fill = (np.ones if (fill_mask_true and x.dtype == np.bool_)
-                else np.zeros)
-        return np.concatenate(
-            [x, fill((bucket - n,) + x.shape[1:], x.dtype)])
+        fill = _pad_fill(bucket - n, x.shape[1:], x.dtype, fill_mask_true)
+        # jsan: disable=alloc-in-hot-loop -- legacy data plane only: the
+        # arena plane always dispatches full-bucket views (n == bucket)
+        return np.concatenate([x, fill])
 
     return jax.tree.map(pad, batch)
 
@@ -195,10 +250,21 @@ class Ewma:
 
     def update(self, x: float) -> float:
         x = float(x)
+        # jsan: disable=shared-state-unlocked -- every Ewma instance is written under exactly one lock (arrival gap: the producers' ring/queue lock; service time: the dispatchers' server lock); the per-class model cannot split instances
         self.count += 1
+        # jsan: disable=shared-state-unlocked -- same per-instance single-lock discipline as above
         self.value = (x if self.value is None
                       else self.alpha * x + (1 - self.alpha) * self.value)
         return self.value
+
+    def reset(self) -> None:
+        """Forget the learned estimate, returning to the cold state
+        (``value is None``). Used when the world the estimate described
+        is gone — e.g. a ``set_active`` weight-swap re-warm invalidates
+        the learned per-dispatch service time, and acting on the stale
+        value would mis-shed / mis-advertise Retry-After."""
+        self.value = None
+        self.count = 0
 
 
 @dataclasses.dataclass
@@ -209,6 +275,173 @@ class _Pending:
     t_submit: float
     future: Future
     deadline_s: "float | None" = None   # relative to t_submit; None = no SLO
+
+
+class _SlotRef:
+    """Read-only view of one pending arena slot for estimator scans
+    (duck-typed like :class:`_Pending` where ``_effective_wait`` needs
+    it: ``t_submit`` and ``deadline_s``)."""
+    __slots__ = ("t_submit", "deadline_s")
+
+    def __init__(self, t_submit: float, deadline_s: "float | None"):
+        self.t_submit = t_submit
+        self.deadline_s = deadline_s
+
+
+class _ArenaBlock:
+    """One bucket-sized slab of the request ring: per-leaf preallocated
+    host arrays (leading axis = ``capacity`` slots) plus parallel
+    per-slot metadata lists. Slots are claimed in order (``claimed`` is
+    the reservation high-water mark); ``published[i]`` flips True — a
+    GIL-atomic list store, no lock — only after slot ``i``'s rows and
+    metadata are fully written, so a consumer never reads a torn row."""
+
+    __slots__ = ("obs", "mask", "stall", "futures", "t_submit", "deadline",
+                 "published", "dead", "claimed", "n_dead", "n_deadlined")
+
+    def __init__(self, obs_leaves, mask_leaves, capacity: int):
+        self.obs = [np.zeros((capacity,) + l.shape, l.dtype)
+                    for l in obs_leaves]
+        self.mask = [np.zeros((capacity,) + l.shape, l.dtype)
+                     for l in mask_leaves]
+        self.stall = np.zeros(capacity, np.int32)
+        self.futures: "list[Future | None]" = [None] * capacity
+        self.t_submit = [0.0] * capacity
+        self.deadline: "list[float | None]" = [None] * capacity
+        self.published = [False] * capacity
+        self.dead = [False] * capacity
+        self.claimed = 0
+        self.n_dead = 0
+        self.n_deadlined = 0
+
+    def reset(self) -> None:
+        """Return the block to the empty state for recycling. Slab
+        contents are NOT zeroed — the dispatch path neutralizes exactly
+        the tail rows it pads with, so stale rows are never read."""
+        for i in range(self.claimed):
+            self.futures[i] = None
+            self.deadline[i] = None
+            self.published[i] = False
+            self.dead[i] = False
+        self.claimed = 0
+        self.n_dead = 0
+        self.n_deadlined = 0
+
+
+class _ArenaRing:
+    """Fixed-capacity MPSC ring of :class:`_ArenaBlock` slabs.
+
+    Producers reserve a slot under ``lock`` — an O(1) critical section
+    (sequence bump; on block rollover, one deque rotation) — then write
+    the row and publish OUTSIDE the lock. The consumer takes whole
+    blocks (FIFO: sealed blocks first, else it force-seals the current
+    one) and recycles them after scatter; a full ring back-pressures
+    producers on ``cond`` until a block frees (the bounded-memory
+    contract — the legacy deque grew without bound)."""
+
+    def __init__(self, obs_leaves, mask_leaves, bucket: int,
+                 n_blocks: int, alloc_counter=None):
+        self.bucket = int(bucket)
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self._obs_leaves = obs_leaves
+        self._mask_leaves = mask_leaves
+        self._alloc_counter = alloc_counter
+        self.n_blocks = 0
+        self.depth = 0              # live (not shed) slots not yet taken
+        self.sealed: "collections.deque[_ArenaBlock]" = collections.deque()
+        self.free: "collections.deque[_ArenaBlock]" = collections.deque()
+        self.cur = self._new_block()
+        for _ in range(max(2, n_blocks) - 1):
+            self.free.append(self._new_block())
+
+    def _new_block(self) -> _ArenaBlock:
+        blk = _ArenaBlock(self._obs_leaves, self._mask_leaves, self.bucket)
+        self.n_blocks += 1
+        if self._alloc_counter is not None:
+            # slabs + the stall lane; metadata lists are not ndarrays
+            self._alloc_counter.inc(
+                len(self._obs_leaves) + len(self._mask_leaves) + 1)
+        return blk
+
+    def grow(self, n_blocks: int) -> None:
+        """Ensure at least ``n_blocks`` blocks exist (construction /
+        ``start()`` time only — never on the steady-state path)."""
+        with self.lock:
+            while self.n_blocks < n_blocks:
+                self.free.append(self._new_block())
+            self.cond.notify_all()
+
+    def blocks(self) -> "list[_ArenaBlock]":
+        """Ring-resident blocks in FIFO order (caller holds ``lock``)."""
+        return [*self.sealed, self.cur]
+
+    def take_block(self) -> "_ArenaBlock | None":
+        """Remove and return the oldest block with claimed slots (the
+        current block is force-sealed when nothing older is waiting), or
+        None when the ring is empty. Once taken, a block is invisible to
+        producers and shed scans until :meth:`recycle`."""
+        with self.lock:
+            if self.sealed:
+                blk = self.sealed.popleft()
+            elif self.cur.claimed > 0 and self.free:
+                blk = self.cur
+                self.cur = self.free.popleft()
+            else:
+                return None
+            self.depth -= blk.claimed - blk.n_dead
+            return blk
+
+    def recycle(self, blk: _ArenaBlock) -> None:
+        blk.reset()
+        with self.lock:
+            self.free.append(blk)
+            self.cond.notify_all()
+
+    def head_t_submit(self) -> "float | None":
+        """Submit time of the oldest live published slot (the static
+        hold-wait anchor). Lock-free racy read: a concurrent take makes
+        the anchor momentarily stale, which only shortens a hold."""
+        for blk in (self.sealed[0] if self.sealed else self.cur,):
+            for i in range(blk.claimed):
+                if blk.published[i] and not blk.dead[i]:
+                    return blk.t_submit[i]
+        return None
+
+    def pending_slots(self) -> "list[_SlotRef]":
+        """Snapshot of live pending slots for estimator scans."""
+        out: list[_SlotRef] = []
+        with self.lock:
+            for blk in self.blocks():
+                for i in range(blk.claimed):
+                    if blk.published[i] and not blk.dead[i]:
+                        out.append(_SlotRef(blk.t_submit[i],
+                                            blk.deadline[i]))
+        return out
+
+
+class _RingPending:
+    """Duck-type of the legacy pending deque over the arena ring, so the
+    shared estimator code (and tests that poke ``server._pending``) see
+    one surface: ``len()``/truthiness is the live pending depth,
+    iteration yields :class:`_SlotRef` snapshots."""
+
+    def __init__(self, server: "PolicyServer"):
+        self._server = server
+
+    def __len__(self) -> int:
+        ring = self._server._ring
+        return ring.depth if ring is not None else 0
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    def __iter__(self):
+        ring = self._server._ring
+        return iter(ring.pending_slots() if ring is not None else ())
+
+
+_DATA_PLANES = ("arena", "legacy")
 
 
 class PolicyServer:
@@ -225,6 +458,19 @@ class PolicyServer:
     continuous batching, where a dispatch grabs whatever is pending the
     moment the previous one finishes.
 
+    **Data planes** (ISSUE 17). ``data_plane="arena"`` (default) is the
+    zero-copy hot path: ``submit`` memcpys the request row straight into
+    a preallocated slab slot (reserved under a tiny O(1) ring lock, the
+    copy itself outside any lock), ``pump`` seals and dispatches slab
+    views, and steady state allocates no host ndarrays per batch. Slabs
+    are sized from ``example_obs``/``example_mask`` at construction when
+    given, else lazily from the first submitted request (row shapes and
+    dtypes are then FIXED: later submits must match, and float inputs
+    are cast to the arena dtype instead of silently promoting the
+    batch). ``data_plane="legacy"`` keeps the pre-arena
+    stack/pad/scatter path — the measured "before" arm of
+    ``serve.bench.run_host_path``.
+
     SLO surface (the ``registry`` gauges/counters, re-rendered by both
     the ``metrics.prom`` snapshot and the live scrape endpoint):
     ``serve_requests_total``, ``serve_dispatches_total``,
@@ -235,30 +481,57 @@ class PolicyServer:
     pre-computed percentiles), ``serve_latency_sample_window`` (live
     reservoir size), ``serve_decision_latency_p50_ms`` / ``_p99_ms``
     and ``serve_decisions_per_s`` (+ ``_per_chip``) via
-    :meth:`slo_snapshot`.
+    :meth:`slo_snapshot`, and ``serve_arena_allocs_total`` (host
+    ndarrays allocated by the arena — warmup/ring-growth only; a moving
+    value in steady state is a regression and the ci.sh host-path stage
+    gates on it).
 
     With a ``tracer`` attached (``serve --trace-spans``) the request
     lifecycle lands on the flight recorder: an ``enqueue`` instant per
-    submit, then ``bucket_wait`` -> ``serve_batch`` (``stack`` ->
-    engine ``pad``/``dispatch`` -> ``scatter``) per pump.
+    submit, then ``bucket_wait`` -> ``serve_batch`` (``arena_seal`` on
+    the arena plane / ``stack`` on the legacy plane -> engine
+    ``pad``/``dispatch`` -> ``scatter``) per pump.
+
+    When the engine exposes ``add_rewarm_listener`` (the router does),
+    the server registers a callback that RESETS the learned service-time
+    Ewma on weight-swap re-warm: the estimate described the old fleet
+    shape/weights, and stale values would mis-shed admissions and
+    mis-advertise ``Retry-After``.
     """
 
     def __init__(self, engine, registry=None, latency_window: int = 8192,
                  clock=time.perf_counter, max_wait_s: float | None = None,
                  tracer=None, sample_seed: int = 0,
-                 adaptive_wait: bool = False):
+                 adaptive_wait: bool = False, data_plane: str = "arena",
+                 example_obs: Any = None, example_mask: Any = None,
+                 arena_blocks: "int | None" = None):
         from ..obs import Registry
         self.engine = engine
         self.registry = registry if registry is not None else Registry()
         self.tracer = tracer if tracer is not None else NULL_TRACER
         if max_wait_s is not None and max_wait_s < 0:
             raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
+        if data_plane not in _DATA_PLANES:
+            raise ValueError(f"data_plane must be one of {_DATA_PLANES}, "
+                             f"got {data_plane!r}")
+        if arena_blocks is not None and arena_blocks < 2:
+            raise ValueError(f"arena_blocks must be >= 2, "
+                             f"got {arena_blocks}")
         self.max_wait_s = max_wait_s
         self.adaptive_wait = bool(adaptive_wait)
+        self.data_plane = data_plane
         self._clock = clock
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
-        self._pending: collections.deque[_Pending] = collections.deque()
+        self._sleepers = 0          # consumers parked on _wake (under _lock)
+        self._shed_lock = threading.Lock()   # serializes shed counting
+        self._pending: Any = (collections.deque()
+                              if data_plane == "legacy"
+                              else _RingPending(self))
+        self._ring: "_ArenaRing | None" = None
+        self._min_blocks = (arena_blocks if arena_blocks is not None
+                            else max(4, min(128, 1024
+                                            // int(engine.max_bucket))))
         # lifetime-uniform reservoirs, not rings: a soak run's p99 must
         # describe the whole run, not its trailing window
         self._latencies = Reservoir(latency_window, seed=sample_seed)
@@ -304,6 +577,74 @@ class PolicyServer:
             "background pumps that raised after resolving their batch's "
             "futures exceptionally (the dispatcher survives and keeps "
             "serving)")
+        self._arena_allocs = self.registry.counter(
+            "serve_arena_allocs_total",
+            "host ndarrays allocated by the arena data plane (slab "
+            "construction and ring growth; steady state must stay flat)")
+        if (example_obs is None) != (example_mask is None):
+            raise ValueError("example_obs and example_mask must be given "
+                             "together (the arena is sized from both)")
+        if example_obs is not None and data_plane == "arena":
+            self.ensure_arena(example_obs, example_mask)
+        add_listener = getattr(engine, "add_rewarm_listener", None)
+        if callable(add_listener):
+            add_listener(self._on_engine_rewarm)
+
+    # ---- estimator lifecycle -----------------------------------------
+
+    def _on_engine_rewarm(self) -> None:
+        """Engine/router weight-swap re-warm callback: the learned
+        per-dispatch service time described the PREVIOUS fleet, so
+        forget it (admission goes back to cold-admit until relearned,
+        and the frontend's Retry-After falls back to its floor)."""
+        with self._lock:
+            self._service_time.reset()
+
+    # ---- arena construction ------------------------------------------
+
+    def _row_leaves(self, tree: Any) -> "list[np.ndarray]":
+        import jax
+        return [np.asarray(l) for l in jax.tree.leaves(tree)]
+
+    def ensure_arena(self, example_obs: Any, example_mask: Any) -> None:
+        """Build the slab ring from one example request row (no leading
+        batch axis). Called from the constructor when examples are
+        given, else lazily by the first :meth:`submit`; idempotent.
+        Row shapes and dtypes are fixed from the example."""
+        if self.data_plane != "arena" or self._ring is not None:
+            return
+        import jax
+        with self._lock:
+            if self._ring is not None:
+                return
+            obs_leaves = self._row_leaves(example_obs)
+            mask_leaves = self._row_leaves(example_mask)
+            self._obs_treedef = jax.tree.structure(example_obs)
+            self._mask_treedef = jax.tree.structure(example_mask)
+            self._obs_is_leaf = (self._obs_treedef.num_leaves == 1
+                                 and isinstance(example_obs, np.ndarray))
+            self._mask_is_leaf = (self._mask_treedef.num_leaves == 1
+                                  and isinstance(example_mask, np.ndarray))
+            self._obs_row_shapes = [l.shape for l in obs_leaves]
+            self._mask_row_shapes = [l.shape for l in mask_leaves]
+            # single-ndarray-leaf rows take a no-loop submit fast path
+            self._fast_rows = self._obs_is_leaf and self._mask_is_leaf
+            self._ring = _ArenaRing(
+                obs_leaves, mask_leaves, int(self.engine.max_bucket),
+                self._min_blocks, alloc_counter=self._arena_allocs)
+
+    def arena_stats(self) -> dict:
+        """Arena occupancy/allocation surface for benches and CI gates."""
+        ring = self._ring
+        return {
+            "data_plane": self.data_plane,
+            "blocks": ring.n_blocks if ring is not None else 0,
+            "rows": (ring.n_blocks * ring.bucket
+                     if ring is not None else 0),
+            "slab_allocs": int(self._arena_allocs.value),
+        }
+
+    # ---- shed plumbing -----------------------------------------------
 
     def _reject(self, fut: Future, exc: DeadlineSheddedError,
                 reason: str) -> None:
@@ -313,13 +654,18 @@ class PolicyServer:
         scans (or abandoned via ``Future.cancel``) is counted at most
         once, and only when someone will actually observe the rejection.
         Conservation (submitted == resolved + shed) is structural, not
-        best-effort."""
+        best-effort. The counter bump takes its own tiny lock: rejects
+        fire from producer threads (admission) and dispatcher threads
+        (expiry) which no longer share a queue lock."""
         try:
             fut.set_exception(exc)
         except BaseException:   # cancelled, or already resolved elsewhere
             return
-        self._shed.inc()
+        with self._shed_lock:
+            self._shed.inc()
         self.tracer.instant("shed", reason=reason)
+
+    # ---- submit ------------------------------------------------------
 
     def submit(self, obs: Any, mask: Any, stall: int = 0,
                deadline_s: "float | None" = None) -> Future:
@@ -335,7 +681,18 @@ class PolicyServer:
         exceptionally with :class:`DeadlineSheddedError` — typed, never
         a silent drop — and ``serve_shed_total`` counts it. Admission
         only rejects once the service-time estimator has observations
-        (a cold server admits everything rather than guessing)."""
+        (a cold server admits everything rather than guessing).
+
+        On the arena plane this call performs the ONE host copy of the
+        request's life: the row lands directly in the current slab slot
+        (wire bytes -> arena when called from the frontend's
+        ``np.frombuffer`` views). Rows that don't match the arena's
+        fixed shapes raise ``ValueError`` here, at the door."""
+        if self.data_plane == "legacy":
+            return self._submit_legacy(obs, mask, stall, deadline_s)
+        return self._submit_arena(obs, mask, stall, deadline_s)
+
+    def _submit_legacy(self, obs, mask, stall, deadline_s) -> Future:
         now = self._clock()
         fut: Future = Future()
         req = _Pending(obs=obs, mask=mask, stall=int(stall),
@@ -370,7 +727,151 @@ class PolicyServer:
         self.tracer.instant("enqueue", stall=int(stall))
         return fut
 
+    def _write_row(self, blk: _ArenaBlock, i: int, obs, mask,
+                   stall: int) -> None:
+        """The one memcpy: request row -> slab slot ``i``. Shape
+        mismatches raise before any slab write (no torn rows)."""
+        if self._obs_is_leaf and isinstance(obs, np.ndarray):
+            obs_leaves = (obs,)
+        else:
+            import jax
+            obs_leaves = jax.tree.leaves(obs)
+        if self._mask_is_leaf and isinstance(mask, np.ndarray):
+            mask_leaves = (mask,)
+        else:
+            import jax
+            mask_leaves = jax.tree.leaves(mask)
+        if len(obs_leaves) != len(blk.obs):
+            raise ValueError(
+                f"obs has {len(obs_leaves)} leaves, arena expects "
+                f"{len(blk.obs)}")
+        if len(mask_leaves) != len(blk.mask):
+            raise ValueError(
+                f"mask has {len(mask_leaves)} leaves, arena expects "
+                f"{len(blk.mask)}")
+        for j, leaf in enumerate(obs_leaves):
+            if np.shape(leaf) != self._obs_row_shapes[j]:
+                raise ValueError(
+                    f"obs leaf {j} has shape {np.shape(leaf)}, arena row "
+                    f"is {self._obs_row_shapes[j]}")
+            blk.obs[j][i] = leaf
+        for j, leaf in enumerate(mask_leaves):
+            if np.shape(leaf) != self._mask_row_shapes[j]:
+                raise ValueError(
+                    f"mask leaf {j} has shape {np.shape(leaf)}, arena row "
+                    f"is {self._mask_row_shapes[j]}")
+            blk.mask[j][i] = leaf
+        blk.stall[i] = stall
+
+    def _submit_arena(self, obs, mask, stall, deadline_s) -> Future:
+        if self._ring is None:
+            self.ensure_arena(obs, mask)     # lazy sizing, first request
+        ring = self._ring
+        now = self._clock()
+        fut: Future = Future()
+        deadline_s = None if deadline_s is None else float(deadline_s)
+        shed_exc = None
+        with ring.lock:
+            if self._closed:
+                raise ServerClosedError(
+                    "PolicyServer is closed (drained for shutdown)")
+            if self._stopped:
+                raise ServerClosedError(
+                    "PolicyServer is stopped (drain in flight)")
+            self._requests.inc()
+            if self._t_prev_submit is not None:
+                self._arrival_gap.update(now - self._t_prev_submit)
+            self._t_prev_submit = now
+            svc = self._service_time.value
+            if deadline_s is not None and svc is not None:
+                # dispatches ahead of this request if it joins the queue,
+                # itself included — each costs ~one learned service time
+                ahead = -(-(ring.depth + 1) // self.engine.max_bucket)
+                predicted = ahead * svc
+                if predicted > deadline_s:
+                    shed_exc = DeadlineSheddedError(
+                        "admission", deadline_s, waited_s=0.0,
+                        predicted_wait_s=predicted)
+            if shed_exc is None:
+                # common case inlined: current block has a free slot
+                blk = ring.cur
+                i = blk.claimed
+                if i < ring.bucket:
+                    blk.claimed = i + 1
+                    ring.depth += 1
+                else:
+                    blk, i = self._reserve_slot_locked(ring)
+        if shed_exc is not None:
+            self._reject(fut, shed_exc, reason="admission")
+            return fut
+        # outside every lock: the row copy and the publish store
+        try:
+            # single-leaf fast path inlined: this is the per-request hot
+            # path the host bench measures, and the generic tree walk in
+            # _write_row costs more than the memcpy itself
+            if (self._fast_rows and type(obs) is np.ndarray
+                    and type(mask) is np.ndarray
+                    and obs.shape == self._obs_row_shapes[0]
+                    and mask.shape == self._mask_row_shapes[0]):
+                blk.obs[0][i] = obs
+                blk.mask[0][i] = mask
+                blk.stall[i] = stall
+            else:
+                self._write_row(blk, i, obs, mask, int(stall))
+        except BaseException:
+            # the slot is already reserved — kill it in place (typed
+            # error to the CALLER; there is no future holder to strand)
+            with ring.lock:
+                blk.dead[i] = True
+                blk.n_dead += 1
+                ring.depth -= 1
+            blk.published[i] = True
+            raise
+        blk.t_submit[i] = now
+        blk.deadline[i] = deadline_s
+        blk.futures[i] = fut
+        if deadline_s is not None:
+            blk.n_deadlined += 1
+        blk.published[i] = True      # GIL-atomic store: slot now visible
+        if self._sleepers:           # wake a parked consumer (rare in
+            with self._wake:         # steady state: dispatchers stay hot)
+                self._wake.notify_all()
+        if self.tracer is not NULL_TRACER:
+            self.tracer.instant("enqueue", stall=int(stall))
+        return fut
+
+    def _reserve_slot_locked(self, ring: _ArenaRing):
+        """Claim the next slot (caller holds ``ring.lock``). Rolls the
+        current block over when full; a completely full ring waits for
+        the consumer to recycle a block (bounded slices so a close()
+        during the wait raises instead of hanging)."""
+        while True:
+            blk = ring.cur
+            i = blk.claimed
+            if i < ring.bucket:
+                blk.claimed = i + 1
+                ring.depth += 1
+                return blk, i
+            if ring.free:               # rollover: seal, swap in a free
+                ring.sealed.append(blk)
+                ring.cur = ring.free.popleft()
+                continue
+            # ring full: producer backpressure until a block recycles
+            ring.cond.wait(timeout=0.05)
+            if self._closed or self._stopped:
+                raise ServerClosedError(
+                    "PolicyServer is closing (arena ring drained for "
+                    "shutdown)")
+
+    # ---- expiry ------------------------------------------------------
+
     def _shed_expired(self, now: float) -> None:
+        if self.data_plane == "legacy":
+            self._shed_expired_legacy(now)
+        else:
+            self._shed_expired_arena(now)
+
+    def _shed_expired_legacy(self, now: float) -> None:
         """Drop queued requests whose deadline already passed (called
         under ``self._lock``); their futures resolve with the typed
         rejection. Head-first scan is NOT enough: deadlines are
@@ -388,6 +889,41 @@ class PolicyServer:
             else:
                 keep.append(r)
         self._pending = keep
+
+    def _shed_expired_arena(self, now: float) -> None:
+        """Arena expiry: expired slots are marked dead IN PLACE (their
+        slab rows become padding at dispatch) instead of being removed
+        from a queue; the typed rejections fire outside the ring lock.
+        Full scan, same reason as the legacy plane: per-request
+        deadlines mean a generous head can hide an expired tail."""
+        ring = self._ring
+        if ring is None:
+            return
+        expired: "list[tuple[Future, float, float]]" = []
+        with ring.lock:
+            blocks = ring.blocks()
+            if not any(b.n_deadlined for b in blocks):
+                return
+            for blk in blocks:
+                for i in range(blk.claimed):
+                    if not blk.published[i] or blk.dead[i]:
+                        continue
+                    d = blk.deadline[i]
+                    if d is None:
+                        continue
+                    waited = now - blk.t_submit[i]
+                    if waited > d:
+                        blk.dead[i] = True
+                        blk.n_dead += 1
+                        blk.n_deadlined -= 1
+                        ring.depth -= 1
+                        expired.append((blk.futures[i], d, waited))
+                        blk.futures[i] = None
+        for fut, d, waited in expired:
+            self._reject(fut, DeadlineSheddedError(
+                "expired", d, waited_s=waited), reason="expired")
+
+    # ---- adaptive hold -----------------------------------------------
 
     def _effective_wait(self) -> "float | None":
         """The partial-bucket hold time for THIS pump (called under
@@ -415,11 +951,15 @@ class PolicyServer:
             waits.append(max(min(slacks) - svc, 0.0))
         return min(waits) if waits else None
 
+    # ---- pump --------------------------------------------------------
+
     def pump(self, max_wait_s: float | None = None) -> int:
-        """Drain one coalesced batch: pop up to ``engine.max_bucket``
-        pending requests (FIFO), pad to the bucket, dispatch, scatter
-        results to their futures. Returns the number of requests served
-        (0 = queue was empty).
+        """Drain one coalesced batch: take up to ``engine.max_bucket``
+        pending requests (FIFO), dispatch, scatter results to their
+        futures. Returns the number of requests served (0 = queue was
+        empty). On the arena plane the "batch" is one slab: tail slots
+        are neutralized in place and the engine sees a contiguous
+        full-bucket view — no stacking, no padding copies.
 
         ``max_wait_s`` (default: the constructor's policy; ``None`` = no
         wait) is the batching deadline: a PARTIAL bucket holds off
@@ -435,28 +975,55 @@ class PolicyServer:
         after the hold (:meth:`_shed_expired`). A :meth:`stop` drain
         cuts the wait short so shutdown never hangs on a sparse
         queue."""
+        if self.data_plane == "legacy":
+            return self._pump_legacy(max_wait_s)
+        return self._pump_arena(max_wait_s)
+
+    def _hold_for_bucket(self, pending_depth, max_wait_s: "float | None",
+                         head_t_submit) -> None:
+        """Shared partial-bucket hold loop (caller holds ``self._lock``).
+        ``pending_depth``/``head_t_submit`` are callables so both planes
+        reuse the anchor/deadline policy. The sleep re-checks depth
+        AFTER advertising itself in ``_sleepers`` — with arena producers
+        publishing outside this lock, that ordering (producer: publish
+        then read ``_sleepers``; consumer: increment then re-check) is
+        what makes the wakeup race-free without a per-submit lock."""
+        wait = (max_wait_s if max_wait_s is not None
+                else self._effective_wait())
+        if wait is None:
+            return
+        # static mode anchors at the head's submit time (total head wait
+        # bounded by the knob); adaptive mode anchors NOW — its estimate
+        # already folds in the head's remaining slack
+        if max_wait_s is None and self.adaptive_wait:
+            anchor = self._clock()
+        else:
+            head = head_t_submit()
+            anchor = head if head is not None else self._clock()
+        deadline = anchor + wait
+        with self.tracer.span("bucket_wait"):
+            while (pending_depth() < self.engine.max_bucket
+                   and not self._stopped):
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    break
+                self._sleepers += 1
+                try:
+                    if (pending_depth() < self.engine.max_bucket
+                            and not self._stopped):
+                        self._wake.wait(timeout=remaining)
+                finally:
+                    self._sleepers -= 1
+
+    def _pump_legacy(self, max_wait_s: "float | None") -> int:
         with self._lock:
             self._shed_expired(self._clock())
             if self._pending:
-                wait = (max_wait_s if max_wait_s is not None
-                        else self._effective_wait())
-                if wait is not None:
-                    # static mode anchors at the head's submit time
-                    # (total head wait bounded by the knob); adaptive
-                    # mode anchors NOW — its estimate already folds in
-                    # the head's remaining slack
-                    anchor = (self._clock()
-                              if max_wait_s is None and self.adaptive_wait
-                              else self._pending[0].t_submit)
-                    deadline = anchor + wait
-                    with self.tracer.span("bucket_wait"):
-                        while (len(self._pending) < self.engine.max_bucket
-                               and not self._stopped):
-                            remaining = deadline - self._clock()
-                            if remaining <= 0:
-                                break
-                            self._wake.wait(timeout=remaining)
-                    self._shed_expired(self._clock())
+                self._hold_for_bucket(
+                    lambda: len(self._pending), max_wait_s,
+                    lambda: (self._pending[0].t_submit
+                             if self._pending else None))
+                self._shed_expired(self._clock())
             batch = [self._pending.popleft()
                      for _ in range(min(len(self._pending),
                                         self.engine.max_bucket))]
@@ -480,10 +1047,162 @@ class PolicyServer:
                 if not r.future.cancelled():
                     r.future.set_exception(e)
             raise
-        # accounting under the lock: concurrent dispatcher threads
-        # (start(dispatchers=N) over a router) share every reservoir,
-        # counter, and estimator below
         lats = [now - r.t_submit for r in batch]
+        self._account_dispatch(
+            now, t_disp, n, bucket, lats,
+            t_first=min(r.t_submit for r in batch))
+        for r, a, lat in zip(batch, per_req, lats):
+            r.future.set_result(ServeResult(action=a, latency_s=lat))
+        return n
+
+    def _seal_block(self, blk: _ArenaBlock):
+        """Turn a taken block into a dispatchable contiguous prefix:
+        wait out in-flight row copies (bounded by one memcpy — the
+        producer published its reservation before we took the block),
+        compact live rows over dead ones (shed slots become padding),
+        and neutralize the pad tail IN PLACE (zero obs, all-legal bool
+        masks, zero stall) — pure slice assignment, no allocation.
+        Returns ``(n_live, bucket, futures, t_submits)``."""
+        spin_deadline = time.monotonic() + 5.0
+        while not all(blk.published[:blk.claimed]):
+            if time.monotonic() > spin_deadline:
+                # a producer died mid-copy (interpreter teardown); its
+                # slot has no future holder — treat it as dead padding
+                for i in range(blk.claimed):
+                    if not blk.published[i]:
+                        blk.published[i] = True
+                        blk.dead[i] = True
+                        blk.n_dead += 1
+                break
+            time.sleep(50e-6)
+        live = [i for i in range(blk.claimed) if not blk.dead[i]]
+        n_live = len(live)
+        if n_live == 0:
+            return 0, 0, [], []
+        if n_live != blk.claimed:
+            # compact: shift live rows down over dead ones (dst <= src,
+            # so in-place row moves are safe); rare — shed path only
+            for dst, src in enumerate(live):
+                if dst == src:
+                    continue
+                for leaf in blk.obs:
+                    leaf[dst] = leaf[src]
+                for leaf in blk.mask:
+                    leaf[dst] = leaf[src]
+                blk.stall[dst] = blk.stall[src]
+                blk.futures[dst] = blk.futures[src]
+                blk.t_submit[dst] = blk.t_submit[src]
+        bucket = next_bucket(n_live, self.engine.max_bucket)
+        if n_live < bucket:
+            for leaf in blk.obs:
+                leaf[n_live:bucket] = 0
+            for leaf in blk.mask:
+                leaf[n_live:bucket] = (True if leaf.dtype == np.bool_
+                                       else 0)
+            blk.stall[n_live:bucket] = 0
+        return (n_live, bucket, blk.futures[:n_live],
+                blk.t_submit[:n_live])
+
+    def _arena_views(self, blk: _ArenaBlock, bucket: int):
+        """Contiguous ``[:bucket]`` views of the slab, re-assembled into
+        the caller's pytree structure (views, never copies)."""
+        if self._obs_is_leaf:
+            obs = blk.obs[0][:bucket]
+        else:
+            import jax
+            obs = jax.tree.unflatten(
+                self._obs_treedef, [l[:bucket] for l in blk.obs])
+        if self._mask_is_leaf:
+            mask = blk.mask[0][:bucket]
+        else:
+            import jax
+            mask = jax.tree.unflatten(
+                self._mask_treedef, [l[:bucket] for l in blk.mask])
+        return obs, mask, blk.stall[:bucket]
+
+    def _scatter_arena(self, blk: _ArenaBlock, actions: Any, n_live: int):
+        """Per-request action views into the single device-fetched
+        actions buffer. If the engine echoed its INPUT back (host-stub
+        engines do), the buffer aliases the slab we are about to
+        recycle — detected with a bounds-only overlap check and copied
+        once, so resolved results can never be corrupted by slab
+        reuse."""
+        import jax
+        leaves = [np.asarray(l) for l in jax.tree.leaves(actions)]
+        slabs = blk.obs + blk.mask + [blk.stall]
+        safe = []
+        for leaf in leaves:
+            if any(np.may_share_memory(leaf, s) for s in slabs):
+                leaf = leaf.copy()
+            safe.append(leaf)
+        if len(safe) == 1 and isinstance(actions, np.ndarray):
+            buf = safe[0]
+            return [buf[i] for i in range(n_live)]
+        treedef = jax.tree.structure(actions)
+        return [jax.tree.unflatten(treedef, [l[i] for l in safe])
+                for i in range(n_live)]
+
+    def _pump_arena(self, max_wait_s: "float | None") -> int:
+        ring = self._ring
+        if ring is None:
+            return 0
+        with self._lock:
+            self._shed_expired(self._clock())
+            if ring.depth > 0:
+                self._hold_for_bucket(lambda: ring.depth, max_wait_s,
+                                      ring.head_t_submit)
+                self._shed_expired(self._clock())
+            blk = ring.take_block()
+            self._depth.set(ring.depth)
+        if blk is None:
+            return 0
+        t_disp = self._clock()
+        try:
+            n_live, bucket, futs, t_subs = self._seal_block(blk)
+        except BaseException:
+            ring.recycle(blk)
+            raise
+        if n_live == 0:
+            ring.recycle(blk)
+            return 0
+        try:
+            if self.tracer is NULL_TRACER:   # span-free hot path
+                obs, mask, stall = self._arena_views(blk, bucket)
+                actions, bucket = self.engine.decide(obs, mask, stall)
+                now = self._clock()
+                per_req = self._scatter_arena(blk, actions, n_live)
+            else:
+                with self.tracer.span("serve_batch", n=n_live):
+                    with self.tracer.span("arena_seal"):
+                        obs, mask, stall = self._arena_views(blk, bucket)
+                    actions, bucket = self.engine.decide(obs, mask, stall)
+                    now = self._clock()
+                    with self.tracer.span("scatter"):
+                        per_req = self._scatter_arena(blk, actions, n_live)
+        except BaseException as e:
+            for fut in futs:
+                if not fut.cancelled():
+                    fut.set_exception(e)
+            ring.recycle(blk)
+            raise
+        lats = [now - t for t in t_subs]
+        self._account_dispatch(now, t_disp, n_live, bucket, lats,
+                               t_first=min(t_subs))
+        for fut, a, lat in zip(futs, per_req, lats):
+            try:
+                fut.set_result(ServeResult(action=a, latency_s=lat))
+            except BaseException:   # cancelled while in flight
+                pass
+        ring.recycle(blk)
+        return n_live
+
+    def _account_dispatch(self, now: float, t_disp: float, n: int,
+                          bucket: int, lats: "list[float]",
+                          t_first: float) -> None:
+        """Per-dispatch accounting under the consumer lock: concurrent
+        dispatcher threads (start(dispatchers=N) over a router) share
+        every reservoir, counter, and estimator below. Producers never
+        take this lock — that is the lock-light contract."""
         with self._lock:
             self._service_time.update(now - t_disp)
             self._dispatches.inc()
@@ -491,7 +1210,7 @@ class PolicyServer:
             self._occupancy.set(n / bucket)
             self._occupancies.append(n / bucket)
             if self._t_first is None:
-                self._t_first = min(r.t_submit for r in batch)
+                self._t_first = t_first
             self._t_last = now if self._t_last is None else max(
                 self._t_last, now)
             self._served += n
@@ -499,11 +1218,14 @@ class PolicyServer:
                 self._latencies.append(lat)
                 self._latency_hist.observe(lat)
             self._sample_window.set(len(self._latencies))
-        for r, a, lat in zip(batch, per_req, lats):
-            r.future.set_result(ServeResult(action=a, latency_s=lat))
-        return n
 
     # ---- live dispatcher thread --------------------------------------
+
+    def _has_work(self) -> bool:
+        if self.data_plane == "legacy":
+            return bool(self._pending)
+        ring = self._ring
+        return ring is not None and ring.depth > 0
 
     def start(self, dispatchers: int = 1) -> None:
         """Start the background dispatchers: pump whenever requests are
@@ -520,14 +1242,24 @@ class PolicyServer:
             raise ServerClosedError("PolicyServer is closed")
         if dispatchers < 1:
             raise ValueError(f"dispatchers must be >= 1, got {dispatchers}")
+        # every in-flight dispatcher can hold one block while another is
+        # current and one stays free — guarantee the ring never wedges
+        self._min_blocks = max(self._min_blocks, dispatchers + 2)
+        if self._ring is not None:
+            self._ring.grow(self._min_blocks)
         self._stopped = False
 
         def loop():
             while True:
                 with self._wake:
-                    while not self._pending and not self._stopped:
-                        self._wake.wait()
-                    if self._stopped and not self._pending:
+                    while not self._has_work() and not self._stopped:
+                        self._sleepers += 1
+                        try:
+                            if not self._has_work() and not self._stopped:
+                                self._wake.wait()
+                        finally:
+                            self._sleepers -= 1
+                    if self._stopped and not self._has_work():
                         return
                 try:
                     self.pump()
@@ -590,8 +1322,11 @@ class PolicyServer:
     def queue_depth(self) -> int:
         """Requests currently queued (the frontend's backpressure
         signal — sampled, so momentarily stale values are fine)."""
-        with self._lock:
-            return len(self._pending)
+        if self.data_plane == "legacy":
+            with self._lock:
+                return len(self._pending)
+        ring = self._ring
+        return ring.depth if ring is not None else 0
 
     def service_time_s(self) -> "float | None":
         """The learned per-dispatch service time (Ewma), ``None`` until

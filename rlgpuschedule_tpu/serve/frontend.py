@@ -29,6 +29,16 @@ failure the serving tier can produce has ONE well-defined HTTP shape:
   a typed :class:`~.batching.ServerClosedError` → **503** — never a
   hung future, never a silently dropped request.
 
+Since ISSUE 17 connections are **persistent**: the HTTP/1.1 loop keeps
+the connection alive between requests (``Connection: close`` — from the
+client, or from the server on drain refusals — ends it), and the same
+port speaks a second, cheaper dialect: a connection whose first 4 bytes
+are :data:`~.wire.MAGIC` is **framed** for its whole life
+(:mod:`.wire` — 24-byte length-prefixed frames, descriptor validated
+by byte equality, no per-request parse). Either way ``np.frombuffer``
+stays the only decode, and the views point straight at the arena slot
+write inside ``submit`` — one copy, wire to slab.
+
 The listener is stdlib-only (``asyncio.start_server`` + hand-rolled
 HTTP/1.1) on purpose: no new dependency, and the protocol surface is
 small enough to pin completely in tier-1 tests. gRPC and multi-node
@@ -40,25 +50,36 @@ import asyncio
 import json
 import math
 import signal
+import socket
 import threading
 from concurrent.futures import Future
 from typing import Any
 
 import numpy as np
 
+from . import wire
 from .batching import DeadlineSheddedError, PolicyServer, ServerClosedError
 
 DECIDE_PATH = "/v1/decide"
 HEALTH_PATH = "/healthz"
 
+# Retry-After sanity band (ISSUE 17 satellite): below 10ms a retry hint
+# is noise (the client's RTT dwarfs it), above 30s it reads as an
+# outage, and a poisoned/stale estimator must not be able to advertise
+# either extreme.
+RETRY_AFTER_MIN_S = 0.01
+RETRY_AFTER_MAX_S = 30.0
+
 
 def _response(status: str, payload: dict,
-              extra_headers: "tuple[str, ...]" = ()) -> bytes:
+              extra_headers: "tuple[str, ...]" = (),
+              close: bool = False) -> bytes:
     body = json.dumps(payload).encode()
     head = [f"HTTP/1.1 {status}",
             "Content-Type: application/json",
             f"Content-Length: {len(body)}",
-            "Connection: keep-alive", *extra_headers]
+            "Connection: close" if close else "Connection: keep-alive",
+            *extra_headers]
     return ("\r\n".join(head) + "\r\n\r\n").encode() + body
 
 
@@ -98,7 +119,19 @@ class ServeFrontend:
         self._obs_shape, self._obs_dtype = obs0.shape, obs0.dtype
         self._mask_shape, self._mask_dtype = mask0.shape, mask0.dtype
         self._obs_nbytes, self._mask_nbytes = obs0.nbytes, mask0.nbytes
+        # frame mode validates the request schema by byte equality
+        # against this descriptor — one ==, no parse on the hot path
+        self._req_descriptor = (wire.descriptor(obs0) + b"|"
+                                + wire.descriptor(mask0))
+        # pre-size the arena from the wire schema so the first request
+        # never pays slab construction mid-traffic
+        ensure = getattr(server, "ensure_arena", None)
+        if callable(ensure):
+            ensure(obs0, mask0)
         self._draining = False
+        # strong refs to backlog-refusal tasks (see _refuse_backlog);
+        # a done callback prunes each when it finishes
+        self._backlog_refusals: "list[asyncio.Task]" = []
         self._inflight = 0
         self._tcp: "asyncio.base_events.Server | None" = None
         self._gate: "asyncio.Event | None" = None       # set = reads flow
@@ -161,8 +194,32 @@ class ServeFrontend:
         already = self._draining
         self._draining = True
         if self._tcp is not None:
+            # A connection that finished its TCP handshake but is not
+            # yet a transport when the listener closes is silently
+            # orphaned — the client hangs on a dead socket. Two windows:
+            # (a) accepted by the selector, accept-task still queued: on
+            #     3.10 Server.close() makes Server._attach assert, the
+            #     error is swallowed and the socket leaks;
+            # (b) still in the kernel accept queue: the listener close
+            #     strands it (Linux does NOT reset queued connections).
+            # Close both: stop the accept reader FIRST, tick the loop so
+            # queued accept tasks attach while the server is still open
+            # (their handlers then serve the typed refusal), dup the
+            # listening sockets (the accept queue lives on the shared
+            # file description), close the listener, and hand every
+            # still-queued connection to the normal handler.
+            loop = asyncio.get_running_loop()
+            for ts in self._tcp.sockets:
+                try:
+                    loop.remove_reader(ts.fileno())
+                except (ValueError, OSError):
+                    pass
+            await asyncio.sleep(0)
+            await asyncio.sleep(0)
+            backlog = [ts.dup() for ts in self._tcp.sockets]
             self._tcp.close()
             await self._tcp.wait_closed()
+            await self._refuse_backlog(backlog)
         if self._gate is not None:
             # wake paused readers: their next request gets a typed 503
             self._gate.set()
@@ -173,6 +230,30 @@ class ServeFrontend:
         if not already:
             # PolicyServer.close joins dispatcher threads — off-loop
             await asyncio.to_thread(self.server.close)
+
+    async def _refuse_backlog(self, socks: "list[socket.socket]") -> None:
+        """Accept whatever the kernel queued on the (now closed)
+        listener and serve each straggler through the normal handler —
+        ``_draining`` is already set, so they get the typed 503/ERR
+        refusal with ``Connection: close`` instead of dead air. The
+        accept pass is non-blocking and the handlers run as loop tasks
+        (NOT awaited here — a straggler that connected but never sends
+        must not hold the drain hostage in the protocol sniff; it is
+        closed when the loop shuts down, which is an EOF to the client,
+        not a hang)."""
+        for ls in socks:
+            ls.setblocking(False)
+            while True:
+                try:
+                    conn, _ = ls.accept()
+                except (BlockingIOError, InterruptedError, OSError):
+                    break
+                reader, writer = await asyncio.open_connection(sock=conn)
+                task = asyncio.ensure_future(
+                    self._on_connection(reader, writer))
+                self._backlog_refusals.append(task)
+                task.add_done_callback(self._backlog_refusals.remove)
+            ls.close()
 
     # ---- backpressure ------------------------------------------------
 
@@ -199,23 +280,21 @@ class ServeFrontend:
                              writer: asyncio.StreamWriter) -> None:
         assert self._gate is not None and self._idle is not None
         try:
-            while True:
-                # connection-level backpressure: do not even READ the
-                # next request while the queue is past high-water
-                if not self._gate.is_set():
-                    await self._gate.wait()
-                req = await self._read_request(reader)
-                if req is None:
-                    return
-                try:
-                    resp = await self._handle(*req)
-                except _BadRequest as e:
-                    self._http_bad.inc()
-                    resp = _response("400 Bad Request",
-                                     {"error": "bad-request",
-                                      "detail": str(e)})
-                writer.write(resp)
-                await writer.drain()
+            # protocol sniff: a framed connection announces itself with
+            # the 4 magic bytes; anything else is HTTP (the sniffed
+            # bytes are re-threaded into the request-line parse)
+            sniff = b""
+            while len(sniff) < len(wire.MAGIC):
+                chunk = await reader.read(len(wire.MAGIC) - len(sniff))
+                if not chunk:
+                    break
+                sniff += chunk
+            if not sniff:
+                return
+            if sniff == wire.MAGIC:
+                await self._serve_framed(reader, writer, sniff)
+            else:
+                await self._serve_http(reader, writer, sniff)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             return   # client went away mid-request; nothing to answer
         finally:
@@ -225,10 +304,57 @@ class ServeFrontend:
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
-    async def _read_request(self, reader: asyncio.StreamReader):
+    async def _serve_http(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter,
+                          prefix: bytes) -> None:
+        """HTTP/1.1 keep-alive loop: one connection serves N requests
+        until the client asks ``Connection: close``, EOF, or the server
+        refuses further work (drain) — refusals carry
+        ``Connection: close`` so a well-behaved client re-resolves
+        instead of pipelining into a dying socket."""
+        while True:
+            # connection-level backpressure: do not even READ the
+            # next request while the queue is past high-water
+            if not self._gate.is_set():
+                await self._gate.wait()
+            try:
+                req = await self._read_request(reader, prefix)
+            except _BadRequest as e:
+                # the request FRAMING is broken — answer 400 and close,
+                # since the stream cannot be resynchronized
+                self._http_bad.inc()
+                writer.write(_response("400 Bad Request",
+                                       {"error": "bad-request",
+                                        "detail": str(e)}, close=True))
+                await writer.drain()
+                return
+            prefix = b""
+            if req is None:
+                return
+            try:
+                resp, close = await self._handle(*req)
+            except _BadRequest as e:
+                self._http_bad.inc()
+                resp, close = _response("400 Bad Request",
+                                        {"error": "bad-request",
+                                         "detail": str(e)}), False
+            headers = req[2]
+            if headers.get("connection", "").lower() == "close":
+                if not close:
+                    resp = resp.replace(b"Connection: keep-alive",
+                                        b"Connection: close", 1)
+                close = True
+            writer.write(resp)
+            await writer.drain()
+            if close:
+                return
+
+    async def _read_request(self, reader: asyncio.StreamReader,
+                            prefix: bytes = b""):
         line = await reader.readline()
-        if not line:
+        if not line and not prefix:
             return None       # clean EOF between requests
+        line = prefix + line
         parts = line.decode("latin-1").split()
         if len(parts) != 3:
             raise _BadRequest("malformed request line")
@@ -271,28 +397,69 @@ class ServeFrontend:
         the dispatch that has to finish before the queue moves), plus
         the predicted excess wait on admission sheds. Always finite and
         positive; 1s only when the estimator is still cold (a shed with
-        a cold estimator can only be an in-queue expiry)."""
+        a cold estimator can only be an in-queue expiry — and a
+        ``set_active`` weight-swap re-warm RESETS the estimator, so a
+        stale pre-swap value can never leak into this hint). Clamped to
+        [``RETRY_AFTER_MIN_S``, ``RETRY_AFTER_MAX_S``]: a degenerate
+        estimate must not advertise a microsecond retry storm or an
+        hour-long outage."""
         svc = self.server.service_time_s()
         retry = svc if svc is not None else 1.0
         if exc.predicted_wait_s is not None:
             retry += max(exc.predicted_wait_s - exc.deadline_s, 0.0)
-        return max(retry, 1e-3)
+        return min(max(retry, RETRY_AFTER_MIN_S), RETRY_AFTER_MAX_S)
+
+    async def _decide(self, obs, mask, stall: int,
+                      deadline_s: "float | None"):
+        """The transport-agnostic decide core: submit, await, classify.
+        Returns ``(status, payload)`` where status is one of ``"ok"``
+        (payload = :class:`~.batching.ServeResult`), ``"shed"``
+        (payload = (exc, retry_after_s)), ``"closed"`` (payload = detail
+        str), ``"timeout"``."""
+        assert self._idle is not None
+        self._inflight += 1
+        self._idle.clear()
+        try:
+            try:
+                fut = self.server.submit(obs, mask, stall=stall,
+                                         deadline_s=deadline_s)
+            except ServerClosedError:
+                return "closed", "server is draining"
+            try:
+                result = await asyncio.wait_for(
+                    asyncio.wrap_future(fut), self.request_timeout_s)
+            except DeadlineSheddedError as e:
+                return "shed", (e, self._retry_after_s(e))
+            except ServerClosedError:
+                return "closed", "server closed mid-request"
+            except asyncio.TimeoutError:
+                return "timeout", None
+            return "ok", result
+        finally:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle.set()
 
     async def _handle(self, method: str, path: str, headers: dict,
-                      body: bytes) -> bytes:
+                      body: bytes) -> "tuple[bytes, bool]":
+        """One HTTP request -> (response bytes, close-connection flag).
+        Drain/closed refusals close: a kept-alive client pipelining
+        into a draining server gets the typed 503 AND the signal to
+        re-resolve, never a hang."""
         if method == "GET" and path == HEALTH_PATH:
             return _response("200 OK", {
                 "status": "draining" if self._draining else "ok",
-                "queue_depth": self.server.queue_depth()})
+                "queue_depth": self.server.queue_depth()}), False
         if method != "POST" or path != DECIDE_PATH:
             return _response("404 Not Found", {"error": "unknown route",
-                                               "path": path})
+                                               "path": path}), False
         self._http_requests.inc()
         if self._draining:
             self._http_closed.inc()
             return _response("503 Service Unavailable",
                              {"error": "closed",
-                              "detail": "server is draining"})
+                              "detail": "server is draining"},
+                             close=True), True
         obs, mask = self._parse_body(body)
         deadline_s = None
         if "x-deadline-ms" in headers:
@@ -307,49 +474,121 @@ class ServeFrontend:
         except ValueError as e:
             raise _BadRequest("bad X-Stall") from e
 
-        assert self._idle is not None
-        self._inflight += 1
-        self._idle.clear()
-        try:
-            try:
-                fut = self.server.submit(obs, mask, stall=stall,
-                                         deadline_s=deadline_s)
-            except ServerClosedError:
-                self._http_closed.inc()
-                return _response("503 Service Unavailable",
-                                 {"error": "closed",
-                                  "detail": "server is draining"})
-            try:
-                result = await asyncio.wait_for(
-                    asyncio.wrap_future(fut), self.request_timeout_s)
-            except DeadlineSheddedError as e:
-                retry = self._retry_after_s(e)
-                self._http_shed.inc()
-                return _response(
-                    "503 Service Unavailable",
-                    {"error": "shed", "reason": e.reason,
-                     "deadline_ms": e.deadline_s * 1e3,
-                     "waited_ms": e.waited_s * 1e3,
-                     "retry_after_s": retry},
-                    (f"Retry-After: {retry:.3f}",))
-            except ServerClosedError:
-                self._http_closed.inc()
-                return _response("503 Service Unavailable",
-                                 {"error": "closed",
-                                  "detail": "server closed mid-request"})
-            except asyncio.TimeoutError:
-                return _response("504 Gateway Timeout",
-                                 {"error": "timeout",
-                                  "timeout_s": self.request_timeout_s})
-        finally:
-            self._inflight -= 1
-            if self._inflight == 0:
-                self._idle.set()
+        status, payload = await self._decide(obs, mask, stall, deadline_s)
+        if status == "closed":
+            self._http_closed.inc()
+            return _response("503 Service Unavailable",
+                             {"error": "closed", "detail": payload},
+                             close=True), True
+        if status == "shed":
+            exc, retry = payload
+            self._http_shed.inc()
+            return _response(
+                "503 Service Unavailable",
+                {"error": "shed", "reason": exc.reason,
+                 "deadline_ms": exc.deadline_s * 1e3,
+                 "waited_ms": exc.waited_s * 1e3,
+                 "retry_after_s": retry},
+                (f"Retry-After: {retry:.3f}",)), False
+        if status == "timeout":
+            return _response("504 Gateway Timeout",
+                             {"error": "timeout",
+                              "timeout_s": self.request_timeout_s}), False
+        result = payload
         import jax
         action = jax.tree.map(lambda x: np.asarray(x).tolist(),
                               result.action)
-        return _response("200 OK", {"action": action,
-                                    "latency_ms": result.latency_s * 1e3})
+        return _response("200 OK",
+                         {"action": action,
+                          "latency_ms": result.latency_s * 1e3}), False
+
+    # ---- frame mode --------------------------------------------------
+
+    async def _read_frame(self, reader: asyncio.StreamReader,
+                          preread: bytes = b""):
+        head = preread + await reader.readexactly(
+            wire.PREFIX_SIZE - len(preread))
+        kind, hlen, blen, meta64, meta32 = wire.unpack_prefix(head)
+        header = await reader.readexactly(hlen) if hlen else b""
+        body = await reader.readexactly(blen) if blen else b""
+        return kind, header, body, meta64, meta32
+
+    async def _serve_framed(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter,
+                            sniffed: bytes) -> None:
+        """The binary dialect: one persistent connection, N request
+        frames, same shedding/drain semantics as HTTP — an ERR frame
+        with reason ``closed`` is terminal for the connection, exactly
+        like ``Connection: close`` on a 503."""
+        preread = sniffed
+        while True:
+            if not self._gate.is_set():
+                await self._gate.wait()
+            try:
+                frame = await self._read_frame(reader, preread)
+            except wire.WireError as e:
+                self._http_bad.inc()
+                writer.write(wire.pack_error("bad-request",
+                                             {"detail": str(e)}))
+                await writer.drain()
+                return      # framing is lost; the stream cannot resync
+            preread = b""
+            kind, header, body, meta64, meta32 = frame
+            resp, close = await self._handle_frame(kind, header, body,
+                                                   meta64, meta32)
+            writer.write(resp)
+            await writer.drain()
+            if close:
+                return
+
+    async def _handle_frame(self, kind: int, header: bytes, body: bytes,
+                            meta64: int, meta32: int):
+        if kind != wire.KIND_REQ:
+            self._http_bad.inc()
+            return wire.pack_error(
+                "bad-request",
+                {"detail": f"expected KIND_REQ, got {kind}"}), True
+        self._http_requests.inc()
+        if self._draining:
+            self._http_closed.inc()
+            return wire.pack_error(
+                "closed", {"detail": "server is draining"}), True
+        if header != self._req_descriptor:
+            self._http_bad.inc()
+            return wire.pack_error(
+                "bad-request",
+                {"detail": f"descriptor mismatch: got {header!r}, "
+                           f"serving {self._req_descriptor.decode()}"},
+            ), False
+        expected = self._obs_nbytes + self._mask_nbytes
+        if len(body) != expected:
+            self._http_bad.inc()
+            return wire.pack_error(
+                "bad-request",
+                {"detail": f"body must be exactly {expected} bytes, "
+                           f"got {len(body)}"}), False
+        obs, mask = self._parse_body(body)
+        deadline_s = meta64 / 1e6 if meta64 else None
+        status, payload = await self._decide(obs, mask, int(meta32),
+                                             deadline_s)
+        if status == "closed":
+            self._http_closed.inc()
+            return wire.pack_error("closed", {"detail": payload}), True
+        if status == "shed":
+            exc, retry = payload
+            self._http_shed.inc()
+            return wire.pack_error(
+                f"shed:{exc.reason}",
+                {"deadline_ms": exc.deadline_s * 1e3,
+                 "waited_ms": exc.waited_s * 1e3,
+                 "retry_after_s": retry},
+                retry_after_s=retry), False
+        if status == "timeout":
+            return wire.pack_error(
+                "timeout", {"timeout_s": self.request_timeout_s}), False
+        result = payload
+        return wire.pack_response(np.asarray(result.action),
+                                  result.latency_s), False
 
 
 class FrontendHandle:

@@ -310,6 +310,16 @@ class EngineRouter:
             "serve_engines_active", "engines currently taking traffic")
         self._g_total.set(n_engines)
         self._g_active.set(n_engines)
+        # fired after a set_active that re-warmed or resized the fleet;
+        # the PolicyServer resets its service-time Ewma here so a
+        # pre-swap estimate can never leak into Retry-After hints
+        self._rewarm_listeners: "list[Any]" = []
+
+    def add_rewarm_listener(self, cb) -> None:
+        """Register ``cb()`` to run after :meth:`set_active` changes the
+        fleet (spin-up warm or active-count change). Callbacks must be
+        cheap and non-raising; they run outside the router locks."""
+        self._rewarm_listeners.append(cb)
 
     # ---- engine-interface parity -------------------------------------
 
@@ -558,9 +568,16 @@ class EngineRouter:
                 with self._device_lock:
                     self.engines[i].warmup(*self._example)
         with self._lock:
+            changed = bool(need_warm) or sum(self._active) != k
             for i in range(len(self.engines)):
                 self._active[i] = i < k
             self._g_active.set(k)
+        if changed:
+            # the service-time distribution just changed (different
+            # parallelism and/or freshly warmed engines) — listeners
+            # drop stale learned estimates
+            for cb in list(self._rewarm_listeners):
+                cb()
         return k
 
     def apply_autoscale(self, advisor: "AutoscaleAdvisor") -> int:

@@ -1,0 +1,166 @@
+"""Length-prefixed binary framing for the serving front door.
+
+HTTP/1.1 costs a request-line + header parse per decide; for high-fan-in
+clients that cost dominates the host path once the data plane itself is
+zero-copy (ISSUE 17). This module defines the **frame mode** the
+frontend speaks on the same port: a connection whose first 4 bytes are
+``MAGIC`` is framed for its whole life, anything else is HTTP. One
+frame is::
+
+    <4s B  B    H        I         Q        I  >   little-endian
+    magic ver kind  header_len  body_len  meta64  meta32
+    [header: header_len bytes][body: body_len bytes]
+
+- ``kind=KIND_REQ``: header is the request **descriptor** — an exact
+  ascii encoding of the wire schema (``float32:(6,)|bool:(9,)``) that
+  the server validates by BYTE EQUALITY against its own (one ``==``,
+  no parsing on the hot path); ``meta64`` is the deadline in
+  microseconds (0 = no SLO), ``meta32`` the stall count; the body is
+  the raw C-contiguous obs bytes followed by the mask bytes —
+  ``np.frombuffer`` views them straight into :meth:`PolicyServer.submit`,
+  whose arena slot write is the single copy of the request's life.
+- ``kind=KIND_RESP``: header is the action descriptor, ``meta64`` the
+  decision latency in microseconds, body the raw action bytes.
+- ``kind=KIND_ERR``: header is a short ascii reason (``shed:admission``,
+  ``shed:expired``, ``closed``, ``bad-request``), ``meta64`` the
+  suggested retry-after in microseconds (0 = do not retry here), body a
+  small JSON detail payload mirroring the HTTP error shape.
+
+The framing is deliberately dumb: fixed 24-byte prefix, no
+continuation, no multiplexing — amortizing parse cost over a keep-alive
+connection is the whole win, and the protocol stays small enough to pin
+completely in tier-1 tests.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any
+
+import numpy as np
+
+MAGIC = b"RLSF"
+VERSION = 1
+KIND_REQ = 1
+KIND_RESP = 2
+KIND_ERR = 3
+_KINDS = (KIND_REQ, KIND_RESP, KIND_ERR)
+
+PREFIX = struct.Struct("<4sBBHIQI")
+PREFIX_SIZE = PREFIX.size            # 24 bytes
+
+# defensive ceiling: a frame is one request/response row, never a
+# training batch — anything bigger is a corrupt or hostile prefix
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
+class WireError(ValueError):
+    """Malformed frame (bad magic/version/kind, oversized, or a
+    descriptor mismatch). Maps to the transport's bad-request path."""
+
+
+def descriptor(tree: Any) -> bytes:
+    """Exact ascii schema of a host pytree's leaves, in leaf order:
+    ``dtype:(shape)`` joined by ``|``. Validation is byte equality —
+    two ends agree iff their descriptors are identical."""
+    import jax
+    leaves = [np.asarray(l) for l in jax.tree.leaves(tree)]
+    return "|".join(
+        f"{l.dtype.name}:{l.shape}" for l in leaves).encode("ascii")
+
+
+def pack_frame(kind: int, header: bytes, body: bytes = b"",
+               meta64: int = 0, meta32: int = 0) -> bytes:
+    if kind not in _KINDS:
+        raise WireError(f"unknown frame kind {kind}")
+    if len(header) > 0xFFFF:
+        raise WireError(f"header too large ({len(header)} bytes)")
+    if len(body) > MAX_BODY_BYTES:
+        raise WireError(f"body too large ({len(body)} bytes)")
+    return PREFIX.pack(MAGIC, VERSION, kind, len(header), len(body),
+                       meta64, meta32) + header + body
+
+
+def unpack_prefix(buf: bytes) -> "tuple[int, int, int, int, int]":
+    """Parse one 24-byte frame prefix -> (kind, header_len, body_len,
+    meta64, meta32); raises :class:`WireError` on anything that is not
+    a well-formed, sane frame head."""
+    if len(buf) != PREFIX_SIZE:
+        raise WireError(f"prefix must be {PREFIX_SIZE} bytes, "
+                        f"got {len(buf)}")
+    magic, version, kind, hlen, blen, meta64, meta32 = PREFIX.unpack(buf)
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    if version != VERSION:
+        raise WireError(f"unsupported wire version {version}")
+    if kind not in _KINDS:
+        raise WireError(f"unknown frame kind {kind}")
+    if blen > MAX_BODY_BYTES:
+        raise WireError(f"body length {blen} exceeds {MAX_BODY_BYTES}")
+    return kind, hlen, blen, meta64, meta32
+
+
+def pack_request(obs: Any, mask: Any, deadline_s: "float | None" = None,
+                 stall: int = 0) -> bytes:
+    """Client-side helper: one decide request as a single frame."""
+    import jax
+    obs_b = b"".join(np.ascontiguousarray(l).tobytes()
+                     for l in jax.tree.leaves(obs))
+    mask_b = b"".join(np.ascontiguousarray(l).tobytes()
+                      for l in jax.tree.leaves(mask))
+    header = descriptor(obs) + b"|" + descriptor(mask)
+    meta64 = 0 if deadline_s is None else max(int(deadline_s * 1e6), 1)
+    return pack_frame(KIND_REQ, header, obs_b + mask_b,
+                      meta64=meta64, meta32=int(stall))
+
+
+def pack_response(action: Any, latency_s: float) -> bytes:
+    arr = np.ascontiguousarray(action)
+    return pack_frame(KIND_RESP, descriptor(arr), arr.tobytes(),
+                      meta64=max(int(latency_s * 1e6), 0))
+
+
+def pack_error(reason: str, detail: dict,
+               retry_after_s: "float | None" = None) -> bytes:
+    meta64 = (0 if retry_after_s is None
+              else max(int(retry_after_s * 1e6), 1))
+    return pack_frame(KIND_ERR, reason.encode("ascii"),
+                      json.dumps(detail).encode(), meta64=meta64)
+
+
+def recv_frame(sock: socket.socket) -> "tuple[int, bytes, bytes, int, int]":
+    """Blocking client-side frame read -> (kind, header, body, meta64,
+    meta32). Raises :class:`ConnectionError` on EOF mid-frame, and
+    ``EOFError`` on a clean EOF at a frame boundary."""
+    def read_exact(n: int) -> bytes:
+        chunks = []
+        got = 0
+        while got < n:
+            c = sock.recv(n - got)
+            if not c:
+                if got == 0 and not chunks:
+                    raise EOFError("connection closed at frame boundary")
+                raise ConnectionError("connection closed mid-frame")
+            chunks.append(c)
+            got += len(c)
+        return b"".join(chunks)
+
+    kind, hlen, blen, meta64, meta32 = unpack_prefix(
+        read_exact(PREFIX_SIZE))
+    header = read_exact(hlen) if hlen else b""
+    body = read_exact(blen) if blen else b""
+    return kind, header, body, meta64, meta32
+
+
+def unpack_action(header: bytes, body: bytes) -> np.ndarray:
+    """Decode a KIND_RESP payload back into the action array (client
+    side). The descriptor grammar is ``dtype:(shape)``."""
+    try:
+        dtype_name, _, shape_s = header.decode("ascii").partition(":")
+        shape = tuple(int(d) for d in
+                      shape_s.strip("()").split(",") if d.strip())
+        return np.frombuffer(body, dtype=np.dtype(dtype_name)).reshape(
+            shape)
+    except (ValueError, TypeError) as e:
+        raise WireError(f"bad action descriptor {header!r}") from e

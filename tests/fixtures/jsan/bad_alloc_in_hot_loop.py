@@ -1,0 +1,26 @@
+"""Known-bad: fresh ndarray construction on dispatcher paths (3)."""
+import threading
+
+import numpy as np
+
+
+class Dispatcher:
+    def __init__(self):
+        self._pending = []
+        self._t = threading.Thread(target=self.pump_loop)
+
+    def _stack(self, rows):
+        return np.stack(rows)                            # finding
+
+    def pump_loop(self):
+        while self._pending:
+            rows, self._pending = self._pending, []
+            batch = self._stack(rows)
+            pad = np.zeros((8 - len(rows),) + batch.shape[1:])   # finding
+            self.dispatch(np.concatenate([batch, pad]))          # finding
+
+    def dispatch(self, batch):
+        pass
+
+    def start(self):
+        self._t.start()

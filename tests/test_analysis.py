@@ -39,6 +39,7 @@ BAD = {
     "shared-state-unlocked": ("bad_shared_state_unlocked.py", 2),
     "blocking-under-lock": ("bad_blocking_under_lock.py", 3),
     "hung-future": ("bad_hung_future.py", 3),
+    "alloc-in-hot-loop": ("bad_alloc_in_hot_loop.py", 3),
     "refusal-drift": (os.path.join("refusal_bad", "train.py"), 2),
 }
 GOOD = ["good_donation.py", "good_host_sync.py", "good_tracer_leak.py",
@@ -51,6 +52,7 @@ GOOD = ["good_donation.py", "good_host_sync.py", "good_tracer_leak.py",
         "good_shared_state_unlocked.py",
         "good_blocking_under_lock.py",
         "good_hung_future.py",
+        "good_alloc_in_hot_loop.py",
         os.path.join("refusal_good", "configs.py"),
         os.path.join("refusal_good", "train.py")]
 

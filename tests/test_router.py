@@ -232,6 +232,45 @@ class TestLeastLoaded:
         assert router.set_active(0) == 1        # never below one engine
         assert router.set_active(99) == 2       # never above the fleet
 
+    def test_set_active_fires_rewarm_listeners_on_change_only(self):
+        """ISSUE 17 satellite: a fleet change (spin-up warm or active-
+        count change) notifies re-warm listeners — the PolicyServer
+        resets its service-time Ewma off this hook — while a no-op
+        ``set_active`` stays silent (no estimator churn on the advisor's
+        steady-state ticks)."""
+        router = make_router(max_bucket=4)
+        fired = []
+        router.add_rewarm_listener(lambda: fired.append(1))
+        assert router.set_active(2) == 2        # already 2: no change
+        assert fired == []
+        assert router.set_active(1) == 1
+        assert len(fired) == 1
+        assert router.set_active(1) == 1        # steady: still silent
+        assert len(fired) == 1
+        rng = np.random.default_rng(7)
+        obs, mask = make_batch(rng, 4)
+        router.warmup(obs[0], mask[0])          # engine 1 inactive: cold
+        router.set_active(2)                    # spin-up warm => fires
+        assert len(fired) == 2
+
+    def test_policy_server_resets_estimator_on_router_rewarm(self):
+        """End-to-end wiring: PolicyServer registers on the router at
+        construction; a set_active fleet change wipes the learned
+        service time (back to cold-admit until relearned)."""
+        router = make_router(max_bucket=4)
+        rng = np.random.default_rng(8)
+        obs, mask = make_batch(rng, 4)
+        router.warmup(obs[0], mask[0])
+        server = PolicyServer(router, example_obs=obs[0],
+                              example_mask=mask[0])
+        for i in range(4):
+            server.submit(obs[i], mask[i])
+        assert server.pump() == 4
+        assert server.service_time_s() is not None
+        router.set_active(1)                    # fleet changed
+        assert server.service_time_s() is None  # estimator reset
+        server.close()
+
     def test_n_engines_validation(self):
         with pytest.raises(ValueError, match="n_engines"):
             make_router(n_engines=0)

@@ -101,6 +101,31 @@ class TestBucketing:
         with pytest.raises(ValueError):
             pad_batch(np.zeros((5, 2)), 4)
 
+    def test_pad_fill_constants_hoisted_and_dtype_stable(self):
+        """ISSUE 17 satellite: the pad-fill constant is built once per
+        (rows, tail, dtype, mask) key, shared immutably across batches,
+        and padding can never promote a leaf's dtype."""
+        from rlgpuschedule_tpu.serve.batching import _pad_fill
+        for dtype in (np.float32, np.float64, np.int32, np.bool_):
+            x = np.ones((3, 2), dtype)
+            out = pad_batch(x, 8)
+            assert out.dtype == x.dtype, dtype       # never promotes
+            assert out.shape == (8, 2)
+        f1 = _pad_fill(5, (2,), np.dtype(np.float32), False)
+        f2 = _pad_fill(5, (2,), np.dtype(np.float32), False)
+        assert f1 is f2                              # hoisted, not rebuilt
+        with pytest.raises((ValueError, RuntimeError)):
+            f1[0] = 1.0                              # shared => immutable
+        # bool + fill_mask_true pads all-legal; bool otherwise pads False
+        m = pad_batch(np.zeros((2, 3), bool), 4, fill_mask_true=True)
+        assert m[2:].all() and m.dtype == np.bool_
+        z = pad_batch(np.ones((2, 3), bool), 4)
+        assert not z[2:].any()
+        # fill_mask_true on a float leaf still pads ZEROS (the flag only
+        # flips boolean mask leaves)
+        f = pad_batch(np.ones((2, 3), np.float32), 4, fill_mask_true=True)
+        assert (f[2:] == 0).all() and f.dtype == np.float32
+
     def test_default_request_sizes_share_one_bucket(self):
         for bucket in (8, 16, 64):
             sizes = default_request_sizes(bucket)
@@ -345,6 +370,176 @@ class TestPolicyServer:
         assert fut.result(timeout=10) is not None
 
 
+class ArgmaxEngine:
+    """Deterministic host-only engine: per-row argmax over obs. Returns
+    a FRESH array per dispatch (so plane-parity is a real comparison,
+    not view aliasing)."""
+
+    def __init__(self, max_bucket=8):
+        self.max_bucket = max_bucket
+        self.post_warmup_recompiles = 0
+
+    def bucket_for(self, n):
+        return next_bucket(n, self.max_bucket)
+
+    def decide(self, obs, mask, stall=None):
+        a = np.argmax(np.asarray(obs), axis=-1).astype(np.int32)
+        return a, self.bucket_for(a.shape[0])
+
+
+class RewarmEngine(ArgmaxEngine):
+    """ArgmaxEngine exposing the router's re-warm listener hook."""
+
+    def __init__(self, max_bucket=8):
+        super().__init__(max_bucket)
+        self.listeners = []
+
+    def add_rewarm_listener(self, cb):
+        self.listeners.append(cb)
+
+
+def request_rows(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.standard_normal(6).astype(np.float32),
+             rng.integers(0, 2, 9).astype(bool) | True)
+            for _ in range(n)]
+
+
+class TestArenaDataPlane:
+    """ISSUE 17 tentpole: the preallocated batch arena — zero
+    steady-state ndarray construction, plane parity, zero-copy scatter
+    views, shape policing at the door, and the estimator re-warm
+    reset."""
+
+    def test_plane_parity_bit_identical(self):
+        rows = request_rows(40)
+        actions = {}
+        for plane in ("legacy", "arena"):
+            server = PolicyServer(ArgmaxEngine(8), data_plane=plane,
+                                  example_obs=rows[0][0],
+                                  example_mask=rows[0][1])
+            futs = [server.submit(o, m) for o, m in rows]
+            while server.pump():
+                pass
+            actions[plane] = np.stack(
+                [np.asarray(f.result(timeout=10).action) for f in futs])
+            server.close()
+        np.testing.assert_array_equal(actions["legacy"], actions["arena"])
+
+    def test_zero_steady_state_allocations(self):
+        """THE perf contract: after warmup, a full-bucket round on the
+        arena plane calls none of the numpy batch constructors and
+        allocates no new slabs; the legacy plane's nonzero count is the
+        churn being deleted (and proves the counter sees through)."""
+        from rlgpuschedule_tpu.serve.bench import StubEngine, _AllocCounter
+        rows = request_rows(16)
+        counts = {}
+        for plane in ("legacy", "arena"):
+            reg = Registry()
+            server = PolicyServer(StubEngine(8), registry=reg,
+                                  data_plane=plane,
+                                  example_obs=rows[0][0],
+                                  example_mask=rows[0][1])
+
+            def one_round():
+                for i in range(8):
+                    server.submit(*rows[i % len(rows)])
+                return server.pump()
+
+            for _ in range(4):                      # warmup: ring growth
+                one_round()
+            slabs_before = server.arena_stats()["slab_allocs"]
+            served = 0
+            with _AllocCounter() as counter:
+                for _ in range(32):
+                    served += one_round()
+            counts[plane] = counter.calls
+            assert served == 32 * 8                  # conservation
+            assert (server.arena_stats()["slab_allocs"]
+                    == slabs_before)                 # no slab growth
+            server.close()
+        assert counts["arena"] == 0
+        assert counts["legacy"] > 0
+
+    def test_scatter_returns_views_into_actions_buffer(self):
+        """Zero-copy tail: when the engine's actions don't alias the
+        request slabs (the device-fetch shape) and rows are non-scalar,
+        scatter hands back VIEWS of the actions buffer, not per-row
+        copies. (Scalar-per-request actions degenerate to numpy scalars
+        — there is no 0-d view to take.)"""
+        class VecActionEngine(ArgmaxEngine):
+            def __init__(self, max_bucket=8):
+                super().__init__(max_bucket)
+                self.buf = np.zeros((max_bucket, 2), np.int32)
+
+            def decide(self, obs, mask, stall=None):
+                n = np.asarray(obs).shape[0]
+                return self.buf[:n], self.bucket_for(n)
+
+        rows = request_rows(8)
+        engine = VecActionEngine(8)
+        server = PolicyServer(engine, data_plane="arena",
+                              example_obs=rows[0][0],
+                              example_mask=rows[0][1])
+        futs = [server.submit(o, m) for o, m in rows]
+        assert server.pump() == 8
+        for f in futs:
+            action = np.asarray(f.result(timeout=10).action)
+            assert action.shape == (2,)
+            assert np.may_share_memory(action, engine.buf)
+        server.close()
+
+    def test_submit_rejects_wrong_row_shape_at_the_door(self):
+        rows = request_rows(2)
+        server = PolicyServer(ArgmaxEngine(8), data_plane="arena",
+                              example_obs=rows[0][0],
+                              example_mask=rows[0][1])
+        with pytest.raises(ValueError):
+            server.submit(np.zeros(7, np.float32), rows[0][1])
+        with pytest.raises(ValueError):
+            server.submit(rows[0][0], np.ones(4, bool))
+        # the arena survives the rejections: a good row still serves
+        fut = server.submit(*rows[1])
+        assert server.pump() == 1
+        assert fut.result(timeout=10) is not None
+        server.close()
+
+    def test_arena_stats_surface(self):
+        rows = request_rows(1)
+        server = PolicyServer(ArgmaxEngine(8), data_plane="arena",
+                              example_obs=rows[0][0],
+                              example_mask=rows[0][1])
+        stats = server.arena_stats()
+        assert stats["data_plane"] == "arena"
+        assert stats["blocks"] >= 1
+        assert stats["rows"] == stats["blocks"] * 8
+        # one counted allocation per slab array: obs leaves + mask
+        # leaves + the stall vector, per block
+        assert stats["slab_allocs"] == stats["blocks"] * 3
+        legacy = PolicyServer(ArgmaxEngine(8), data_plane="legacy")
+        assert legacy.arena_stats()["blocks"] == 0
+        legacy.close()
+        server.close()
+
+    def test_rewarm_listener_resets_service_time_estimator(self):
+        """ISSUE 17 satellite: a fleet re-warm (weight swap /
+        set_active) resets the learned service time — admission returns
+        to cold-admit instead of shedding on the stale estimate."""
+        rows = request_rows(8)
+        engine = RewarmEngine(8)
+        server = PolicyServer(engine, data_plane="arena",
+                              example_obs=rows[0][0],
+                              example_mask=rows[0][1])
+        assert len(engine.listeners) == 1            # hook registered
+        for o, m in rows:
+            server.submit(o, m)
+        assert server.pump() == 8
+        assert server.service_time_s() is not None   # learned
+        engine.listeners[0]()                        # fleet re-warmed
+        assert server.service_time_s() is None       # forgotten
+        server.close()
+
+
 class TestBench:
     def test_run_bench_zero_recompiles_across_sizes(self, exp):
         registry = Registry()
@@ -363,6 +558,66 @@ class TestBench:
         assert report["decisions_per_s"] > 0
         assert report["latency_p50_ms"] > 0
         assert report["latency_p99_ms"] >= report["latency_p50_ms"]
+
+    def test_run_host_path_gates_and_report_shape(self):
+        """BENCH_r09's driver: both in-process arms present, the arena
+        arm allocation-free and slab-flat, conservation structural, the
+        stub engine recompile-free. (The >= 2x speedup itself is gated
+        on the recorded BENCH run, not a CI-noise-sensitive assert.)"""
+        from rlgpuschedule_tpu.serve.bench import run_host_path
+        pool = request_rows(16)
+        report = run_host_path(pool, max_bucket=8, rounds=40,
+                               warmup_rounds=4)
+        assert [a["data_plane"] for a in report["arms"]] == \
+            ["legacy", "arena"]
+        arena, legacy = report["arms"][1], report["arms"][0]
+        assert arena["alloc_calls"] == 0
+        assert arena["allocs_per_batch"] == 0
+        assert arena["steady_state_slab_allocs"] == 0
+        assert legacy["alloc_calls"] > 0
+        for arm in report["arms"]:
+            assert arm["conservation_ok"]
+            assert arm["requests"] == 40 * 8
+            assert arm["served"] == 40 * 8 and arm["shed"] == 0
+            assert arm["post_warmup_recompiles"] == 0
+            assert arm["decisions_per_s"] > 0
+        assert arena["arena"]["slab_allocs"] >= 1
+        assert report["speedup"] == report["speedup_inproc"]
+        assert not report["paced"]
+
+    def test_run_host_path_wire_arms_over_live_sockets(self):
+        """The transport half of BENCH_r09: HTTP connection-per-request
+        (pre-PR) vs one framed keep-alive connection per client
+        (post-PR), both conserving every request, with the headline
+        speedup switched to the wire ratio."""
+        from rlgpuschedule_tpu.serve.bench import run_host_path
+        pool = request_rows(16)
+        report = run_host_path(pool, max_bucket=8, rounds=10,
+                               warmup_rounds=2, wire_requests=64,
+                               clients=4)
+        before, after = report["wire_arms"]
+        assert before["transport"] == "http connection-per-request"
+        assert before["data_plane"] == "legacy"
+        assert after["transport"] == "framed keep-alive"
+        assert after["data_plane"] == "arena"
+        for arm in report["wire_arms"]:
+            assert arm["conservation_ok"]
+            assert arm["served"] == arm["requests"]
+            assert arm["decisions_per_s"] > 0
+            assert arm["post_warmup_recompiles"] == 0
+        assert report["speedup"] == pytest.approx(
+            after["decisions_per_s"] / before["decisions_per_s"])
+        assert "speedup_inproc" in report
+
+    def test_run_host_path_refusals(self):
+        from rlgpuschedule_tpu.serve.bench import run_host_path
+        pool = request_rows(4)
+        with pytest.raises(ValueError, match="rounds"):
+            run_host_path(pool, rounds=0)
+        with pytest.raises(ValueError, match="empty request pool"):
+            run_host_path([])
+        with pytest.raises(ValueError, match="rate_hz"):
+            run_host_path(pool, fit=object())
 
 
 class TestFleetReplay:
@@ -513,6 +768,18 @@ class TestServeCLI:
         assert report["repro"]["ckpt_step"] == 3
         assert report["repro"]["ckpt_dir"] == str(tmp_path / "ckpt")
 
+    def test_host_path_mode(self):
+        report = serve_cli.main(
+            SERVE_FAST + ["--host-path", "--bucket", "8",
+                          "--host-rounds", "20", "--pool-steps", "1"])
+        hp = report["host_path"]
+        arena = [a for a in hp["arms"] if a["data_plane"] == "arena"][0]
+        assert arena["alloc_calls"] == 0
+        assert arena["steady_state_slab_allocs"] == 0
+        assert all(a["conservation_ok"] for a in hp["arms"])
+        assert hp["speedup"] > 0
+        assert "wire_arms" not in hp                   # not requested
+
     def test_refusals(self):
         with pytest.raises(SystemExit):
             serve_cli.main(SERVE_FAST)                     # no mode
@@ -532,3 +799,9 @@ class TestServeCLI:
         with pytest.raises(SystemExit):
             serve_cli.main(SERVE_FAST + ["--fleet", "1",
                                          "--fleet-regime", "nope"])
+        with pytest.raises(SystemExit):                    # silent no-op
+            serve_cli.main(SERVE_FAST + ["--wire-requests", "64",
+                                         "--bench"])
+        with pytest.raises(SystemExit):
+            serve_cli.main(SERVE_FAST + ["--host-path",
+                                         "--host-rounds", "0"])

@@ -397,10 +397,10 @@ class TestServeObservability:
         points = [e["span"] for e in events if e["kind"] == SPAN_POINT]
         assert points == ["enqueue", "enqueue"]
         begins = [e["span"] for e in events if e["kind"] == SPAN_BEGIN]
-        assert begins == ["serve_batch", "stack", "scatter"]
-        # stack/scatter nest INSIDE serve_batch
+        assert begins == ["serve_batch", "arena_seal", "scatter"]
+        # arena_seal/scatter nest INSIDE serve_batch
         rows = {n["path"]: n for n in build_span_tree(events)}
-        assert "serve_batch/stack" in rows
+        assert "serve_batch/arena_seal" in rows
         assert "serve_batch/scatter" in rows
 
     def test_engine_pad_dispatch_spans(self, tmp_path):

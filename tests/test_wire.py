@@ -1,0 +1,160 @@
+"""Wire-framing unit tests (ISSUE 17): the length-prefixed binary
+protocol is small enough to pin completely — prefix round-trip, the
+descriptor grammar, every rejection path of :func:`unpack_prefix`, the
+request/response/error pack helpers, and the blocking client reader's
+EOF semantics (clean boundary EOF vs mid-frame truncation)."""
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from rlgpuschedule_tpu.serve import wire
+
+
+def example():
+    rng = np.random.default_rng(0)
+    return (rng.standard_normal(6).astype(np.float32),
+            np.ones(9, bool))
+
+
+class TestPrefix:
+    def test_prefix_is_24_bytes(self):
+        assert wire.PREFIX_SIZE == 24
+
+    def test_pack_unpack_round_trip(self):
+        frame = wire.pack_frame(wire.KIND_REQ, b"hdr", b"body",
+                                meta64=123456, meta32=7)
+        kind, hlen, blen, meta64, meta32 = wire.unpack_prefix(
+            frame[:wire.PREFIX_SIZE])
+        assert (kind, hlen, blen, meta64, meta32) == \
+            (wire.KIND_REQ, 3, 4, 123456, 7)
+        assert frame[wire.PREFIX_SIZE:wire.PREFIX_SIZE + hlen] == b"hdr"
+        assert frame[wire.PREFIX_SIZE + hlen:] == b"body"
+
+    @pytest.mark.parametrize("mutate,msg", [
+        (lambda b: b"XXXX" + b[4:], "bad magic"),
+        (lambda b: b[:4] + bytes([99]) + b[5:], "wire version"),
+        (lambda b: b[:5] + bytes([0]) + b[6:], "frame kind"),
+        (lambda b: b[:-1], "must be 24 bytes"),
+    ])
+    def test_unpack_prefix_rejects_malformed(self, mutate, msg):
+        good = wire.pack_frame(wire.KIND_REQ, b"", b"")
+        with pytest.raises(wire.WireError, match=msg):
+            wire.unpack_prefix(mutate(good[:wire.PREFIX_SIZE]))
+
+    def test_unpack_prefix_rejects_oversized_body(self):
+        raw = wire.PREFIX.pack(wire.MAGIC, wire.VERSION, wire.KIND_REQ,
+                               0, wire.MAX_BODY_BYTES + 1, 0, 0)
+        with pytest.raises(wire.WireError, match="exceeds"):
+            wire.unpack_prefix(raw)
+
+    def test_pack_frame_rejects_bad_kind_and_oversize(self):
+        with pytest.raises(wire.WireError, match="kind"):
+            wire.pack_frame(0, b"")
+        with pytest.raises(wire.WireError, match="header too large"):
+            wire.pack_frame(wire.KIND_REQ, b"x" * 0x10000)
+
+
+class TestDescriptor:
+    def test_descriptor_is_exact_ascii_schema(self):
+        obs, mask = example()
+        assert wire.descriptor(obs) == b"float32:(6,)"
+        assert wire.descriptor(mask) == b"bool:(9,)"
+        # pytrees flatten in leaf order
+        assert wire.descriptor({"a": obs, "b": mask}) == \
+            b"float32:(6,)|bool:(9,)"
+
+    def test_descriptor_distinguishes_dtype_and_shape(self):
+        a = np.zeros(4, np.float32)
+        assert wire.descriptor(a) != wire.descriptor(a.astype(np.float64))
+        assert wire.descriptor(a) != wire.descriptor(np.zeros(5, np.float32))
+
+
+class TestPackHelpers:
+    def test_pack_request_carries_deadline_and_stall(self):
+        obs, mask = example()
+        frame = wire.pack_request(obs, mask, deadline_s=0.25, stall=3)
+        kind, hlen, blen, meta64, meta32 = wire.unpack_prefix(
+            frame[:wire.PREFIX_SIZE])
+        assert kind == wire.KIND_REQ
+        assert meta64 == 250_000 and meta32 == 3
+        assert blen == obs.nbytes + mask.nbytes
+        header = frame[wire.PREFIX_SIZE:wire.PREFIX_SIZE + hlen]
+        assert header == wire.descriptor(obs) + b"|" + wire.descriptor(mask)
+        # no deadline -> meta64 == 0 (the "no SLO" sentinel)
+        frame = wire.pack_request(obs, mask)
+        assert wire.unpack_prefix(frame[:wire.PREFIX_SIZE])[3] == 0
+
+    def test_pack_response_action_round_trip(self):
+        action = np.arange(5, dtype=np.int32)
+        frame = wire.pack_response(action, latency_s=0.002)
+        kind, hlen, blen, meta64, _ = wire.unpack_prefix(
+            frame[:wire.PREFIX_SIZE])
+        assert kind == wire.KIND_RESP and meta64 == 2000
+        header = frame[wire.PREFIX_SIZE:wire.PREFIX_SIZE + hlen]
+        body = frame[wire.PREFIX_SIZE + hlen:]
+        out = wire.unpack_action(header, body)
+        np.testing.assert_array_equal(out, action)
+        assert out.dtype == np.int32
+
+    def test_unpack_action_rejects_garbage_descriptor(self):
+        with pytest.raises(wire.WireError, match="bad action descriptor"):
+            wire.unpack_action(b"nonsense", b"")
+
+    def test_pack_error_retry_after_microseconds(self):
+        frame = wire.pack_error("shed:admission", {"x": 1},
+                                retry_after_s=0.05)
+        kind, hlen, _, meta64, _ = wire.unpack_prefix(
+            frame[:wire.PREFIX_SIZE])
+        assert kind == wire.KIND_ERR and meta64 == 50_000
+        assert frame[wire.PREFIX_SIZE:wire.PREFIX_SIZE + hlen] == \
+            b"shed:admission"
+        # retry omitted -> 0 = "do not retry here"
+        frame = wire.pack_error("closed", {})
+        assert wire.unpack_prefix(frame[:wire.PREFIX_SIZE])[3] == 0
+
+
+class TestRecvFrame:
+    def _pipe(self):
+        a, b = socket.socketpair()
+        return a, b
+
+    def test_recv_frame_reassembles_split_writes(self):
+        a, b = self._pipe()
+        try:
+            obs, mask = example()
+            frame = wire.pack_request(obs, mask)
+
+            def dribble():
+                for i in range(0, len(frame), 7):
+                    a.sendall(frame[i:i + 7])
+
+            t = threading.Thread(target=dribble)
+            t.start()
+            kind, header, body, _, _ = wire.recv_frame(b)
+            t.join()
+            assert kind == wire.KIND_REQ
+            assert body == obs.tobytes() + mask.tobytes()
+            assert header == (wire.descriptor(obs) + b"|"
+                              + wire.descriptor(mask))
+        finally:
+            a.close()
+            b.close()
+
+    def test_clean_eof_at_boundary_vs_truncation_mid_frame(self):
+        obs, mask = example()
+        frame = wire.pack_request(obs, mask)
+        # clean close at a frame boundary -> EOFError (normal shutdown)
+        a, b = self._pipe()
+        a.close()
+        with pytest.raises(EOFError):
+            wire.recv_frame(b)
+        b.close()
+        # close mid-frame -> ConnectionError (the peer died on us)
+        a, b = self._pipe()
+        a.sendall(frame[:10])
+        a.close()
+        with pytest.raises(ConnectionError):
+            wire.recv_frame(b)
+        b.close()
