@@ -11,9 +11,14 @@
 #       (each test compiles once by design) and tests/fixtures/ holds
 #       jsan's own deliberately-bad corpus. Baseline:
 #       jsan_baseline.json (EMPTY since PR 15), run with --fail-stale
-#       so the baseline can only shrink. A second jsan invocation emits
-#       SARIF and sanity-checks its shape — the code-scanning upload
-#       must never receive a malformed document.
+#       so the baseline can only shrink. Both invocations share a
+#       --cache dir (PR 18) keyed on (file sha1, analyzer-source sha1):
+#       the SARIF pass replays the text pass's per-file results instead
+#       of re-analyzing, and repeat CI runs skip unchanged files
+#       entirely (cross-file rules always re-run). A second jsan
+#       invocation emits SARIF and sanity-checks its shape, including
+#       the PR-18 column regions — the code-scanning upload must never
+#       receive a malformed document.
 #   1b. ruff + mypy at the pyproject.toml config, pinned there
 #       (ruff==0.6.9, mypy==1.11.2). Both gate on availability: the
 #       hermetic CI image does not ship them, and the lint stage must
@@ -34,15 +39,17 @@ set -euo pipefail
 cd "$(dirname "$0")"
 
 echo "=== lint 1/3: jsan (python -m rlgpuschedule_tpu.analysis) ==="
+JSAN_CACHE="${JSAN_CACHE:-.jsan_cache}"
 python -m rlgpuschedule_tpu.analysis \
     rlgpuschedule_tpu bench.py __graft_entry__.py \
-    --baseline jsan_baseline.json --fail-stale
+    --baseline jsan_baseline.json --fail-stale --cache "$JSAN_CACHE"
 
-echo "=== lint 1/3b: jsan SARIF gate ==="
+echo "=== lint 1/3b: jsan SARIF gate (warm --cache replay) ==="
 JSAN_SARIF=$(mktemp /tmp/ci_jsan.XXXXXX.sarif)
 python -m rlgpuschedule_tpu.analysis \
     rlgpuschedule_tpu bench.py __graft_entry__.py \
-    --baseline jsan_baseline.json --format sarif > "$JSAN_SARIF"
+    --baseline jsan_baseline.json --format sarif \
+    --cache "$JSAN_CACHE" > "$JSAN_SARIF"
 python - "$JSAN_SARIF" <<'PY'
 import json, sys
 doc = json.load(open(sys.argv[1]))
@@ -54,9 +61,13 @@ rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
 for res in run["results"]:
     assert res["ruleId"] in rule_ids, res["ruleId"]
     loc = res["locations"][0]["physicalLocation"]
-    assert loc["artifactLocation"]["uri"] and loc["region"]["startLine"] >= 1
+    assert loc["artifactLocation"]["uri"]
+    region = loc["region"]
+    assert region["startLine"] >= 1 and region["startColumn"] >= 1
+    assert region["endLine"] >= region["startLine"]
+    assert region["endColumn"] > region["startColumn"]  # exclusive end
 print(f"sarif ok: {len(run['results'])} result(s), "
-      f"{len(rule_ids)} rules declared")
+      f"{len(rule_ids)} rules declared, column regions present")
 PY
 rm -f "$JSAN_SARIF"
 
@@ -435,11 +446,12 @@ assert s["serialized_dispatch_cpu"] is True   # honesty bit on this rig
 sc = rep["scrape"]
 assert sc["well_formed"] and sc["status"] == 200, sc
 prom = open(sys.argv[2] + "/metrics.prom").read()
-for series in ("serve_shed_total",
-               "serve_autoscale_desired_engines",
-               "serve_autoscale_resizes_total",
-               "serve_engines_active",
-               'serve_engine_rows_total{engine="0"}',
+# Bare-name presence checks (serve_shed_total, serve_engines_active,
+# serve_autoscale_*) moved to jsan's contract-drift rule, which keeps
+# registrations and consumers in lockstep statically. Only the
+# per-engine LABEL fanout stays a runtime grep — labels are runtime
+# data the static rule cannot see.
+for series in ('serve_engine_rows_total{engine="0"}',
                'serve_engine_rows_total{engine="1"}',
                'serve_recompile_alarms_total{engine="0"}',
                'serve_recompile_alarms_total{engine="1"}'):
@@ -514,10 +526,11 @@ assert fe["decide_status"] == 200 and fe["decide_has_action"], fe
 assert fe["drained"] and fe["late_submit"] == "server-closed", fe
 assert fe["post_drain_connect"] == "refused", fe
 prom = open(sys.argv[2] + "/metrics.prom").read()
-for series in ('serve_engine_ejections_total{engine="0"}',
-               "serve_retry_hedges_total",
-               "serve_frontend_requests_total"):
-    assert series in prom, f"missing scrape series: {series}"
+# serve_retry_hedges_total / serve_frontend_requests_total presence is
+# enforced statically by jsan's contract-drift rule; only the labeled
+# per-engine ejection series needs a runtime grep.
+assert 'serve_engine_ejections_total{engine="0"}' in prom, \
+    "missing scrape series: serve_engine_ejections_total"
 print("chaos-soak smoke ok:", {
     "requests": s["requests"], "shed": s["shed"],
     "faults_fired": s["faults_fired"],

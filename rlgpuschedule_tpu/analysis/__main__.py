@@ -16,10 +16,14 @@ regenerates the file, ``--prune-baseline`` drops entries whose finding
 no longer exists, ``--fail-stale`` turns such stale entries into a
 failure (ci.sh runs with it so the baseline can only shrink).
 
-``--format sarif`` emits SARIF 2.1.0 for code-scanning upload;
+``--format sarif`` emits SARIF 2.1.0 for code-scanning upload (regions
+carry start/end columns so editors can underline);
 ``--diff BASE`` restricts analysis to files changed since a git rev;
 ``--explain RULE`` prints a rule's full rationale (its module
-docstring).
+docstring); ``--cache DIR`` replays per-file results keyed on
+(file sha1, analyzer-source sha1) so warm runs skip unchanged files —
+cross-file rules (refusal-drift, contract-drift) always re-run because
+their verdicts depend on files outside the one being analyzed.
 
 Every text-mode finding carries a stable ID ``<rule>@<path>@<hash>``
 (hash of the offending source line, so it survives line drift) — the
@@ -91,8 +95,14 @@ def _sarif(findings) -> dict:
                 "partialFingerprints": {"jsanFindingId/v1": f.finding_id},
                 "locations": [{"physicalLocation": {
                     "artifactLocation": {"uri": f.path},
+                    # SARIF columns are 1-based and endColumn is
+                    # exclusive; Finding.end_col is 0-based exclusive,
+                    # so both convert with +1 (engine guarantees
+                    # end_col > col, so endColumn > startColumn)
                     "region": {"startLine": f.line,
-                               "startColumn": f.col + 1},
+                               "startColumn": f.col + 1,
+                               "endLine": f.end_line or f.line,
+                               "endColumn": (f.end_col or f.col + 1) + 1},
                 }}],
             } for f in findings],
         }],
@@ -128,6 +138,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--diff", metavar="BASE", default=None,
                    help="only analyze files changed since the git rev "
                         "BASE (intersected with the requested paths)")
+    p.add_argument("--cache", metavar="DIR", default=None,
+                   help="cache per-file findings in DIR, keyed on the "
+                        "file's content hash and the analyzer's own "
+                        "source hash (any rule edit invalidates "
+                        "everything); cross-file rules always re-run")
     p.add_argument("--explain", metavar="RULE", default=None,
                    help="print a rule's full rationale and exit")
     p.add_argument("--list-rules", action="store_true")
@@ -149,7 +164,7 @@ def main(argv: list[str] | None = None) -> int:
                 return 0
         else:
             paths = args.paths
-        findings = analyze_paths(paths)
+        findings = analyze_paths(paths, cache_dir=args.cache)
     except FileNotFoundError as e:
         print(f"jsan: no such path: {e}", file=sys.stderr)
         return 2

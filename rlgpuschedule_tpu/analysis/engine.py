@@ -62,11 +62,22 @@ class Finding:
     rule: str
     message: str
     snippet: str    # stripped source line at ``line``
+    end_line: int = 0   # 1-based; 0 when unknown (defaults to line)
+    end_col: int = 0    # 0-based exclusive; 0 when unknown
 
     def as_dict(self) -> dict:
         return {"path": self.path, "line": self.line, "col": self.col,
                 "rule": self.rule, "message": self.message,
-                "snippet": self.snippet}
+                "snippet": self.snippet, "end_line": self.end_line,
+                "end_col": self.end_col}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(path=d["path"], line=d["line"], col=d["col"],
+                   rule=d["rule"], message=d["message"],
+                   snippet=d["snippet"],
+                   end_line=d.get("end_line", 0),
+                   end_col=d.get("end_col", 0))
 
     @property
     def baseline_key(self) -> tuple[str, str, str]:
@@ -116,8 +127,13 @@ class SourceFile:
     def finding(self, node: ast.AST, rule: str, message: str) -> Finding:
         line = getattr(node, "lineno", 1)
         col = getattr(node, "col_offset", 0)
+        end_line = getattr(node, "end_lineno", None) or line
+        end_col = getattr(node, "end_col_offset", None)
+        if end_col is None or (end_line == line and end_col <= col):
+            end_col = col + 1
         return Finding(path=self.path, line=line, col=col, rule=rule,
-                       message=message, snippet=self.snippet(line))
+                       message=message, snippet=self.snippet(line),
+                       end_line=end_line, end_col=end_col)
 
 
 # ---------------------------------------------------------------------------
@@ -267,6 +283,68 @@ class ModuleContext:
 
 
 # ---------------------------------------------------------------------------
+# incremental cache (ISSUE 18): per-file findings keyed on the file's
+# content hash AND a rule-set hash (the sha1 of every analysis-package
+# source), so editing any rule/model/engine file invalidates everything.
+# Only LOCAL rules are cached — cross-file rules (refusal-drift,
+# contract-drift) read sibling files whose edits a per-file key cannot
+# see, so they re-run every time.
+
+def ruleset_hash() -> str:
+    h = hashlib.sha1()
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    for root, dirs, files in os.walk(pkg):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for f in sorted(files):
+            if f.endswith(".py"):
+                with open(os.path.join(root, f), "rb") as fh:
+                    h.update(f.encode())
+                    h.update(fh.read())
+    return h.hexdigest()
+
+
+class FindingCache:
+    """Findings from local rules, one JSON file per (path, content sha,
+    rule-set sha). Corrupt or unreadable entries read as misses."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self.rules_sha = ruleset_hash()
+        os.makedirs(directory, exist_ok=True)
+        self.hits = 0
+        self.misses = 0
+
+    def _entry(self, path: str, text_sha: str) -> str:
+        key = hashlib.sha1(
+            f"{os.path.abspath(path)}\0{text_sha}\0{self.rules_sha}"
+            .encode()).hexdigest()
+        return os.path.join(self.directory, f"{key}.json")
+
+    def get(self, path: str, text_sha: str) -> "list[Finding] | None":
+        try:
+            with open(self._entry(path, text_sha),
+                      encoding="utf-8") as f:
+                data = json.load(f)
+            findings = [Finding.from_dict(d) for d in data["findings"]]
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+        self.hits += 1
+        return findings
+
+    def put(self, path: str, text_sha: str,
+            findings: "list[Finding]") -> None:
+        self.misses += 1
+        tmp = self._entry(path, text_sha)
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"version": 1,
+                           "findings": [x.as_dict() for x in findings]},
+                          f, sort_keys=True)
+        except OSError:
+            pass   # a read-only cache dir degrades to always-miss
+
+
+# ---------------------------------------------------------------------------
 # driver
 
 def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
@@ -285,25 +363,45 @@ def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
             raise FileNotFoundError(path)
 
 
-def analyze_file(path: str, rules=None) -> list[Finding]:
+def analyze_file(path: str, rules=None,
+                 cache: "FindingCache | None" = None) -> list[Finding]:
     from .rules import all_rules
     rules = all_rules() if rules is None else rules
     with open(path, encoding="utf-8") as f:
         text = f.read()
     src = SourceFile(path.replace(os.sep, "/"), text)
     ctx = ModuleContext(src)
-    findings: list[Finding] = []
-    for rule in rules:
-        for finding in rule.check(src, ctx):
-            if not src.suppressed(finding.line, finding.rule):
-                findings.append(finding)
+    local = [r for r in rules if not r.cross_file]
+    cross = [r for r in rules if r.cross_file]
+
+    def run(subset) -> list[Finding]:
+        out: list[Finding] = []
+        for rule in subset:
+            for finding in rule.check(src, ctx):
+                if not src.suppressed(finding.line, finding.rule):
+                    out.append(finding)
+        return out
+
+    if cache is not None and local:
+        text_sha = hashlib.sha1(text.encode("utf-8")).hexdigest()
+        findings = cache.get(src.path, text_sha)
+        if findings is None:
+            findings = run(local)
+            cache.put(src.path, text_sha, findings)
+        else:
+            findings = list(findings)
+    else:
+        findings = run(local)
+    findings.extend(run(cross))
     return findings
 
 
-def analyze_paths(paths: Iterable[str], rules=None) -> list[Finding]:
+def analyze_paths(paths: Iterable[str], rules=None,
+                  cache_dir: "str | None" = None) -> list[Finding]:
+    cache = FindingCache(cache_dir) if cache_dir else None
     findings: list[Finding] = []
     for path in iter_py_files(paths):
-        findings.extend(analyze_file(path, rules))
+        findings.extend(analyze_file(path, rules, cache=cache))
     return sorted(findings)
 
 

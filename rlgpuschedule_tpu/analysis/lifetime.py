@@ -1,0 +1,660 @@
+"""jsan's value-lifetime and escape model (ISSUE 18 tentpole).
+
+PR 17's arena data plane made buffer *lifetime* a correctness surface:
+submit memcpys into a recycled slab block, scatter hands back views
+into the engine's actions buffer, and the block recycles right after —
+so a view that outlives its block reads torn or recycled memory with no
+exception to point at the bug. The four lifetime rules share this one
+per-module model, built the same way :mod:`.concurrency` builds the
+thread model: once per :class:`~.engine.ModuleContext`, from local
+evidence only.
+
+**View sources** — the calls whose results alias storage someone else
+may reclaim:
+
+1. ``<ring>.take_block()`` — an arena block reservation (the block and
+   everything reached through it: ``blk.obs``, ``blk.futures[:n]``);
+2. ``np.frombuffer(buf, ...)`` — a zero-copy view over ``buf``;
+3. ``scatter_results(actions, n)`` — per-request views into one batched
+   actions buffer (``serve/batching.py``'s documented contract).
+
+**Propagation** — a view taints what it flows into, with two strengths.
+Aliases, subscripts/slices, attribute loads, view-preserving ndarray
+methods (``reshape``/``ravel``/``transpose``/...), and forwarders that
+are documented not to copy (``np.asarray``, ``jax.tree.map`` /
+``unflatten``) stay **strong**: the result provably aliases the source.
+An opaque helper call that merely *receives* a strong view (``n_live =
+self._seal_block(blk)``) yields a **weak** result: it might be a view,
+might be a scalar — weak values only count when later *dereferenced*
+(subscripted / attribute-loaded), never on bare name uses, so a count
+returned past a recycle does not fire. Copies end the chain:
+``.copy()``, ``np.array``, ``np.copy``, ``np.ascontiguousarray``,
+``bytes``/``float``/``int`` conversions.
+
+**Kill points** — after which a tainted value reads reclaimed storage:
+
+1. ``<ring>.recycle(blk)`` / ``blk.reset()`` — kills the block and every
+   view derived from it;
+2. ``sock.recv_into(buf)`` / ``reader.readinto(buf)`` — the next recv
+   into the SAME buffer object invalidates outstanding ``frombuffer``
+   views over it (rebinding ``buf = sock.recv(n)`` does NOT: the old
+   ``bytes`` stays alive under the old view);
+3. a dispatch of a ``jax.jit(..., donate_argnums=...)`` program (tracked
+   by the concurrency model) marks the names passed at donated
+   positions dead — unless the dispatch's own assignment rebinds them
+   (``state = step(state)`` is the blessed idiom).
+
+**Escapes** — where a strong view outliving the function becomes
+someone else's problem: returned (allowed when the function's docstring
+documents the view contract — the repo convention ``_arena_views`` and
+``scatter_results`` follow, mirroring the ``make_*`` naming contract),
+stored on ``self`` or into a ``self`` container, or captured by a
+nested function that is itself returned, stored, or handed to a thread.
+
+Control flow is block-structured, not linear: an ``except`` handler
+that recycles and re-raises does not poison the happy path below it,
+branch kills merge only from branches that fall through, and loop
+bodies are analyzed once (a back-edge use-before-recycle is the
+documented recall limit — the runtime ``may_share_memory`` defence in
+``_scatter_arena`` backstops it).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from .concurrency import model_for as _concurrency_model
+from .engine import ModuleContext
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# ndarray methods whose result aliases the receiver
+_VIEW_METHODS = {"reshape", "view", "ravel", "transpose", "squeeze",
+                 "swapaxes", "byteswap"}
+# calls that end a taint chain (their result owns fresh storage)
+_FRESH_CALLS = {"numpy.array", "numpy.copy", "numpy.ascontiguousarray",
+                "bytes", "bytearray", "float", "int", "bool", "str",
+                "len", "repr", "tuple", "dict", "set", "sum", "min",
+                "max", "abs", "range", "sorted"}
+# calls documented NOT to copy: result aliases any view argument
+_FORWARDERS = {"numpy.asarray", "numpy.atleast_1d", "numpy.atleast_2d",
+               "numpy.reshape", "numpy.ravel", "numpy.transpose",
+               "numpy.squeeze", "jax.tree.map", "jax.tree.unflatten",
+               "jax.tree_util.tree_map", "jax.tree_util.tree_unflatten",
+               "zip", "enumerate", "reversed", "iter", "list"}
+_RECV_INTO = {"recv_into", "readinto", "readinto1", "recv_bytes_into"}
+_CONTAINER_ADD = {"append", "add", "insert", "extend", "appendleft"}
+_PUBLISH = {"put", "put_nowait"}
+
+
+@dataclasses.dataclass
+class View:
+    """One tracked value: where it came from and what it aliases."""
+    kind: str              # "block" | "frombuffer" | "scatter" | "derived"
+    root: int              # family id — kills apply to the whole family
+    origin: ast.AST        # the source call node
+    label: str             # e.g. "ring.take_block()"
+    strong: bool
+    buffer: str | None = None   # frombuffer: backing buffer name
+
+    def derived(self, strong: "bool | None" = None) -> "View":
+        return View(kind="derived", root=self.root, origin=self.origin,
+                    label=self.label,
+                    strong=self.strong if strong is None else strong,
+                    buffer=self.buffer)
+
+
+@dataclasses.dataclass
+class Escape:
+    node: ast.AST          # the escaping statement/expression
+    view: View
+    how: str               # "returned" | "stored on self.x" | ...
+    fn: ast.AST
+    documented: bool       # enclosing docstring documents a view contract
+
+
+@dataclasses.dataclass
+class DeadUse:
+    node: ast.AST          # the use
+    view: View
+    kill: ast.AST          # the statement that reclaimed the storage
+    kill_label: str
+    fn: ast.AST
+
+
+@dataclasses.dataclass
+class Publish:
+    node: ast.AST          # the .put()/submit/Thread call
+    view: View
+    channel: str           # e.g. "self._q.put"
+    fn: ast.AST
+
+
+@dataclasses.dataclass
+class DonatedUse:
+    node: ast.AST          # the post-dispatch use
+    name: str
+    dispatch: ast.AST      # the donating call
+    fn: ast.AST
+
+
+class _State:
+    """Per-path abstract state: live views, killed families, donated-dead
+    names. Cheap to fork at branches, merged at join points."""
+
+    __slots__ = ("live", "killed", "donated", "terminated")
+
+    def __init__(self):
+        self.live: dict[str, View] = {}
+        self.killed: dict[int, tuple[ast.AST, str]] = {}
+        self.donated: dict[str, ast.Call] = {}
+        self.terminated = False
+
+    def fork(self) -> "_State":
+        st = _State()
+        st.live = dict(self.live)
+        st.killed = dict(self.killed)
+        st.donated = dict(self.donated)
+        return st
+
+    def merge(self, *others: "_State") -> None:
+        """Join with sibling paths: kills/donations union over every
+        path that falls through; a terminated path contributes nothing
+        (its recycle cannot precede the code below the join)."""
+        for other in others:
+            if other.terminated:
+                continue
+            self.live.update({k: v for k, v in other.live.items()
+                              if k not in self.live})
+            self.killed.update(other.killed)
+            self.donated.update(other.donated)
+
+
+class LifetimeModel:
+    """Escapes, dead uses, publishes, and donated-alias reuses for ONE
+    module (built once per :class:`ModuleContext`, shared by the four
+    lifetime rules)."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.cmodel = _concurrency_model(ctx)
+        self.escapes: list[Escape] = []
+        self.dead_uses: list[DeadUse] = []
+        self.publishes: list[Publish] = []
+        self.donated_uses: list[DonatedUse] = []
+        self.has_sources = False
+        self._global_names = {
+            t.id for n in ctx.tree.body
+            if isinstance(n, (ast.Assign, ast.AnnAssign))
+            for t in (n.targets if isinstance(n, ast.Assign)
+                      else [n.target])
+            if isinstance(t, ast.Name)}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._analyze_fn(node)
+
+    # -- helpers ------------------------------------------------------------
+    def _expr_text(self, node: ast.AST) -> str:
+        try:
+            return ast.unparse(node)
+        except Exception:
+            return "<expr>"
+
+    def _docstring_documents_views(self, fn: ast.AST) -> bool:
+        doc = ast.get_docstring(fn, clean=False) or ""
+        return "view" in doc.lower()
+
+    def _donate_positions(self, jit_site: ast.AST) -> tuple[int, ...]:
+        if not isinstance(jit_site, ast.Call):
+            return ()
+        for kw in jit_site.keywords:
+            if kw.arg == "donate_argnums":
+                try:
+                    val = ast.literal_eval(kw.value)
+                except ValueError:
+                    return ()
+                if isinstance(val, int):
+                    return (val,)
+                if isinstance(val, (tuple, list)):
+                    return tuple(v for v in val if isinstance(v, int))
+        return ()
+
+    # -- value classification ----------------------------------------------
+    def _value_view(self, expr: ast.AST, st: _State) -> View | None:
+        if isinstance(expr, ast.Name):
+            return st.live.get(expr.id)
+        if isinstance(expr, ast.Starred):
+            return self._value_view(expr.value, st)
+        if isinstance(expr, ast.Subscript):
+            base = self._value_view(expr.value, st)
+            return base.derived() if base is not None else None
+        if isinstance(expr, ast.Attribute):
+            base = self._value_view(expr.value, st)
+            return base.derived() if base is not None else None
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            for elt in expr.elts:
+                v = self._value_view(elt, st)
+                if v is not None:
+                    return v.derived()
+            return None
+        if isinstance(expr, ast.IfExp):
+            return (self._value_view(expr.body, st)
+                    or self._value_view(expr.orelse, st))
+        if isinstance(expr, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            # [l[:bucket] for l in blk.obs] — a container of views when
+            # the iterable is tracked
+            for gen in expr.generators:
+                v = self._value_view(gen.iter, st)
+                if v is not None:
+                    return v.derived()
+            return None
+        if isinstance(expr, ast.Call):
+            return self._call_view(expr, st)
+        return None
+
+    def _call_view(self, call: ast.Call, st: _State) -> View | None:
+        func = call.func
+        resolved = self.ctx.resolve(func)
+        if resolved is not None and resolved in _FRESH_CALLS:
+            return None
+        if isinstance(func, ast.Attribute):
+            if func.attr == "take_block":
+                self.has_sources = True
+                return View(kind="block", root=id(call), origin=call,
+                            label=f"{self._expr_text(func)}()",
+                            strong=True)
+            recv = self._value_view(func.value, st)
+            if recv is not None:
+                if func.attr in ("copy", "tolist", "tobytes", "item",
+                                 "sum", "mean", "get"):
+                    return None
+                if func.attr in _VIEW_METHODS:
+                    return recv.derived()
+        if resolved == "numpy.frombuffer":
+            buf = (call.args[0].id if call.args
+                   and isinstance(call.args[0], ast.Name) else None)
+            self.has_sources = True
+            return View(kind="frombuffer", root=id(call), origin=call,
+                        label=f"np.frombuffer({buf or '...'})",
+                        strong=True, buffer=buf)
+        is_scatter = (isinstance(func, ast.Name)
+                      and func.id == "scatter_results") or (
+                          resolved is not None
+                          and resolved.endswith(".scatter_results"))
+        if is_scatter:
+            base = (self._value_view(call.args[0], st)
+                    if call.args else None)
+            self.has_sources = True
+            return View(kind="scatter",
+                        root=base.root if base is not None else id(call),
+                        origin=call, label="scatter_results(...)",
+                        strong=True)
+        # forwarders alias their view arguments; anything else that
+        # receives a strong view yields only a weak "maybe a view"
+        tracked = self._tracked_args(call, st)
+        if not tracked:
+            return None
+        best = max(tracked, key=lambda v: v.strong)
+        if resolved is not None and resolved in _FORWARDERS:
+            return best.derived()
+        if best.strong:
+            return best.derived(strong=False)
+        return None
+
+    def _tracked_args(self, call: ast.Call, st: _State) -> list[View]:
+        out: list[View] = []
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for node in ast.walk(arg):
+                if isinstance(node, _FuncNode):
+                    break
+                if isinstance(node, ast.Name) and node.id in st.live:
+                    out.append(st.live[node.id])
+        return out
+
+    # -- use scanning -------------------------------------------------------
+    def _iter_loads(self, expr: ast.AST):
+        """Name loads in an expression, not descending into nested
+        function bodies (they execute later, on their own analysis)."""
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _FuncNode):
+                continue
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _is_deref(self, name_node: ast.AST) -> bool:
+        """Is this Name dereferenced as array data (subscript, attribute,
+        slice) rather than merely mentioned? Weak views only fire here."""
+        parent = self.ctx.parents.get(name_node)
+        return isinstance(parent, (ast.Subscript, ast.Attribute,
+                                   ast.Starred))
+
+    def _check_uses(self, expr: ast.AST | None, st: _State,
+                    fn: ast.AST) -> None:
+        if expr is None:
+            return
+        for name in self._iter_loads(expr):
+            view = st.live.get(name.id)
+            if view is not None and view.root in st.killed:
+                if view.strong or self._is_deref(name):
+                    kill, label = st.killed[view.root]
+                    self.dead_uses.append(DeadUse(
+                        node=name, view=view, kill=kill,
+                        kill_label=label, fn=fn))
+            if name.id in st.donated:
+                self.donated_uses.append(DonatedUse(
+                    node=name, name=name.id,
+                    dispatch=st.donated[name.id], fn=fn))
+
+    # -- donated dispatch ---------------------------------------------------
+    def _donated_dispatches(self, expr: ast.AST, st: _State):
+        """(call, donated arg names) for dispatches of tracked donated
+        programs inside ``expr``."""
+        for node in ast.walk(expr):
+            if isinstance(node, _FuncNode):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            tok = self.cmodel.value_token(node.func, node)
+            if tok is None or tok not in self.cmodel.donated:
+                continue
+            positions = self._donate_positions(self.cmodel.donated[tok])
+            if not positions:
+                continue
+            if any(isinstance(a, ast.Starred) for a in node.args):
+                continue   # splatted args: positions unknowable
+            names = [node.args[i].id for i in positions
+                     if i < len(node.args)
+                     and isinstance(node.args[i], ast.Name)]
+            if names:
+                yield node, names
+
+    # -- escapes ------------------------------------------------------------
+    def _record_escape(self, node: ast.AST, view: View, how: str,
+                       fn: ast.AST) -> None:
+        if not view.strong:
+            return
+        self.escapes.append(Escape(
+            node=node, view=view, how=how, fn=fn,
+            documented=self._docstring_documents_views(fn)))
+
+    def _escape_target(self, target: ast.AST) -> str | None:
+        """A store target that outlives the function: ``self.x``,
+        ``self.x[k]``, or a module-level global."""
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self":
+            return f"self.{target.attr}"
+        if isinstance(target, ast.Subscript):
+            return self._escape_target(target.value)
+        if isinstance(target, ast.Name) \
+                and target.id in self._global_names:
+            return target.id
+        return None
+
+    # -- function walk ------------------------------------------------------
+    def _analyze_fn(self, fn: ast.AST) -> None:
+        st = _State()
+        self._exec_block(fn.body, st, fn)
+        self._closure_pass(fn, st)
+
+    def _exec_block(self, stmts, st: _State, fn: ast.AST) -> None:
+        for stmt in stmts:
+            if st.terminated:
+                break
+            self._exec_stmt(stmt, st, fn)
+
+    def _bind(self, target: ast.AST, view: View | None,
+              st: _State) -> None:
+        if isinstance(target, ast.Name):
+            st.donated.pop(target.id, None)
+            if view is not None:
+                st.live[target.id] = view
+            else:
+                st.live.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, view.derived() if view is not None
+                           else None, st)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, view, st)
+
+    def _exec_stmt(self, stmt: ast.AST, st: _State, fn: ast.AST) -> None:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is None:
+                return
+            self._check_uses(value, st, fn)
+            bound = self._bound_names(stmt)
+            for call, names in self._donated_dispatches(value, st):
+                for name in names:
+                    if name not in bound:
+                        st.donated[name] = call
+            view = self._value_view(value, st)
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for target in targets:
+                dest = self._escape_target(target)
+                if dest is not None and view is not None:
+                    self._record_escape(stmt, view,
+                                        f"stored on {dest}", fn)
+                self._bind(target, view, st)
+        elif isinstance(stmt, ast.Expr):
+            self._check_uses(stmt.value, st, fn)
+            if isinstance(stmt.value, ast.Call):
+                self._exec_call_stmt(stmt.value, st, fn)
+            for call, names in self._donated_dispatches(stmt.value, st):
+                for name in names:
+                    st.donated[name] = call
+        elif isinstance(stmt, ast.Return):
+            self._check_uses(stmt.value, st, fn)
+            if stmt.value is not None:
+                view = self._value_view(stmt.value, st)
+                if view is not None:
+                    self._record_escape(stmt, view, "returned", fn)
+            st.terminated = True
+        elif isinstance(stmt, ast.Raise):
+            self._check_uses(stmt.exc, st, fn)
+            st.terminated = True
+        elif isinstance(stmt, (ast.Break, ast.Continue)):
+            st.terminated = True
+        elif isinstance(stmt, ast.If):
+            self._check_uses(stmt.test, st, fn)
+            then = st.fork()
+            other = st.fork()
+            self._exec_block(stmt.body, then, fn)
+            self._exec_block(stmt.orelse, other, fn)
+            if then.terminated and other.terminated and stmt.orelse:
+                st.terminated = True
+            st.merge(then, other)
+        elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            if isinstance(stmt, ast.While):
+                self._check_uses(stmt.test, st, fn)
+            else:
+                self._check_uses(stmt.iter, st, fn)
+                view = self._value_view(stmt.iter, st)
+                self._bind(stmt.target, view.derived()
+                           if view is not None else None, st)
+            body = st.fork()
+            self._exec_block(stmt.body, body, fn)
+            st.merge(body)
+            self._exec_block(stmt.orelse, st, fn)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_uses(item.context_expr, st, fn)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars,
+                               self._value_view(item.context_expr, st), st)
+            self._exec_block(stmt.body, st, fn)
+        elif isinstance(stmt, ast.Try):
+            body = st.fork()
+            self._exec_block(stmt.body, body, fn)
+            exits = [body]
+            for handler in stmt.handlers:
+                h = st.fork()
+                self._exec_block(handler.body, h, fn)
+                exits.append(h)
+            if all(e.terminated for e in exits):
+                st.terminated = True
+            st.merge(*exits)
+            self._exec_block(stmt.finalbody, st, fn)
+            self._exec_block(stmt.orelse, st, fn)
+        elif isinstance(stmt, ast.Delete):
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    st.live.pop(target.id, None)
+                    st.donated.pop(target.id, None)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            pass   # nested defs: handled by _closure_pass + own analysis
+        elif isinstance(stmt, (ast.Assert, ast.Global, ast.Nonlocal,
+                               ast.Pass, ast.Import, ast.ImportFrom,
+                               ast.ClassDef)):
+            if isinstance(stmt, ast.Assert):
+                self._check_uses(stmt.test, st, fn)
+
+    def _bound_names(self, stmt: ast.AST) -> set[str]:
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        out: set[str] = set()
+        for t in targets:
+            for node in ast.walk(t):
+                if isinstance(node, ast.Name):
+                    out.add(node.id)
+        return out
+
+    def _exec_call_stmt(self, call: ast.Call, st: _State,
+                        fn: ast.AST) -> None:
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return
+        attr = func.attr
+        # kill: <ring>.recycle(blk) — the block family dies here
+        if attr == "recycle" and call.args \
+                and isinstance(call.args[0], ast.Name):
+            view = st.live.get(call.args[0].id)
+            if view is not None:
+                st.killed[view.root] = (
+                    call, self._expr_text(call))
+            return
+        # kill: blk.reset() on a tracked block
+        if attr == "reset" and isinstance(func.value, ast.Name):
+            view = st.live.get(func.value.id)
+            if view is not None and view.kind == "block":
+                st.killed[view.root] = (call, self._expr_text(call))
+            return
+        # kill: the next recv into the same buffer invalidates
+        # outstanding frombuffer views over it
+        if attr in _RECV_INTO and call.args \
+                and isinstance(call.args[0], ast.Name):
+            buf = call.args[0].id
+            for view in st.live.values():
+                if view.buffer == buf:
+                    st.killed[view.root] = (
+                        call, self._expr_text(call))
+            return
+        # escape: self.cache.append(view) — stored past the call frame
+        if attr in _CONTAINER_ADD:
+            dest = self._escape_target(func.value)
+            if dest is not None:
+                for arg in call.args:
+                    view = self._value_view(arg, st)
+                    if view is not None:
+                        self._record_escape(
+                            call, view, f"appended to {dest}", fn)
+            return
+        # publish: view handed to another thread through a queue, an
+        # executor, or a Thread target closure
+        if attr in _PUBLISH:
+            for arg in call.args:
+                view = self._value_view(arg, st)
+                if view is not None and view.strong:
+                    self.publishes.append(Publish(
+                        node=call, view=view,
+                        channel=f"{self._expr_text(func)}()", fn=fn))
+            return
+        if attr == "submit":
+            for arg in call.args:
+                if isinstance(arg, ast.Lambda):
+                    for name in self._iter_loads(arg.body):
+                        view = st.live.get(name.id)
+                        if view is not None and view.strong:
+                            self.publishes.append(Publish(
+                                node=call, view=view,
+                                channel=f"{self._expr_text(func)}()",
+                                fn=fn))
+                else:
+                    view = self._value_view(arg, st)
+                    if view is not None and view.strong:
+                        self.publishes.append(Publish(
+                            node=call, view=view,
+                            channel=f"{self._expr_text(func)}()", fn=fn))
+
+    # -- closure captures ---------------------------------------------------
+    def _closure_pass(self, fn: ast.AST, st: _State) -> None:
+        """A nested def that captures a strong view AND is returned,
+        stored on self, or handed to a thread escapes the view with it.
+        ``st`` is the fall-through exit state; captures are judged
+        against every name the function ever tracked, which is
+        conservative in the right direction for closures (they run
+        later)."""
+        nested = [n for n in fn.body
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))]
+        if not nested:
+            return
+        for inner in nested:
+            captured: View | None = None
+            params = {a.arg for a in inner.args.args
+                      + inner.args.posonlyargs + inner.args.kwonlyargs}
+            for name in self._iter_loads_in_fn(inner):
+                if name.id in params:
+                    continue
+                view = st.live.get(name.id)
+                if view is not None and view.strong:
+                    captured = view
+                    break
+            if captured is None:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == inner.name:
+                    self._record_escape(
+                        node, captured,
+                        f"captured by returned closure "
+                        f"{inner.name!r}", fn)
+                if isinstance(node, ast.Call):
+                    name = self.ctx.resolve(node.func)
+                    is_thread = (name in ("threading.Thread",
+                                          "threading.Timer"))
+                    is_submit = (isinstance(node.func, ast.Attribute)
+                                 and node.func.attr == "submit")
+                    if not (is_thread or is_submit):
+                        continue
+                    handed = [a for a in node.args] + [
+                        kw.value for kw in node.keywords
+                        if kw.arg == "target"]
+                    if any(isinstance(a, ast.Name) and a.id == inner.name
+                           for a in handed):
+                        self.publishes.append(Publish(
+                            node=node, view=captured,
+                            channel=f"thread closure {inner.name!r}",
+                            fn=fn))
+
+    def _iter_loads_in_fn(self, fn: ast.AST):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load):
+                yield node
+
+
+def model_for(ctx: ModuleContext) -> LifetimeModel:
+    """The module's (memoized) lifetime model — the four lifetime rules
+    in one analyze_file pass share a single build."""
+    model = getattr(ctx, "_jsan_lifetime", None)
+    if model is None:
+        model = LifetimeModel(ctx)
+        ctx._jsan_lifetime = model
+    return model

@@ -14,22 +14,32 @@ class Rule:
     name: str
     summary: str
     check: Callable[[SourceFile, ModuleContext], Iterable[Finding]]
+    # cross-file rules read sibling files (refusal tables, metric
+    # consumers) whose edits a per-file cache key cannot see, so the
+    # engine's --cache never stores their findings and always re-runs
+    # them (engine.FindingCache)
+    cross_file: bool = False
 
 
 def all_rules() -> list[Rule]:
     from . import (alloc_in_hot_loop, blocking_under_lock,
-                   compile_off_thread, device_dispatch_unlocked, donation,
+                   compile_off_thread, contract_drift,
+                   device_dispatch_unlocked, donated_alias_reuse, donation,
                    donation_cross_thread, host_sync, hung_future,
                    impure_in_jit, prng_reuse, recompile, refusal_drift,
-                   shared_state_unlocked, sync_in_loop, tracer_leak,
-                   unconstrained_intermediate)
+                   shared_state_unlocked, sync_in_loop, torn_publish,
+                   tracer_leak, unconstrained_intermediate,
+                   use_after_recycle, view_escape)
     return [donation.RULE, host_sync.RULE, sync_in_loop.RULE,
             tracer_leak.RULE, impure_in_jit.RULE, recompile.RULE,
             prng_reuse.RULE, unconstrained_intermediate.RULE,
             compile_off_thread.RULE, device_dispatch_unlocked.RULE,
             donation_cross_thread.RULE, shared_state_unlocked.RULE,
             blocking_under_lock.RULE, hung_future.RULE,
-            alloc_in_hot_loop.RULE, refusal_drift.RULE]
+            alloc_in_hot_loop.RULE, refusal_drift.RULE,
+            view_escape.RULE, use_after_recycle.RULE,
+            donated_alias_reuse.RULE, torn_publish.RULE,
+            contract_drift.RULE]
 
 
 def rule_names() -> list[str]:

@@ -1,0 +1,682 @@
+"""contract-drift: emitted names and their consumers, in lockstep.
+
+Three observability/wire contracts cross every surface of this repo,
+and nothing type-checks them: **metric names** registered on the obs
+``Registry`` (``counter``/``gauge``/``histogram``) and then grepped out
+of Prometheus text by ci.sh stages and test assertions; **event kinds**
+emitted on the ``EventBus`` and matched by ``e["kind"] == ...`` checks
+in chaos gates and ``obs/report.py``; and the **wire frame constants**
+(``MAGIC``/``VERSION``/``struct`` prefix) that tests pin as golden
+bytes. A renamed metric silently turns a CI grep into a tautology; a
+retired event kind leaves a chaos gate asserting against a kind nothing
+emits; a wire-format edit that forgets the golden bytes ships a
+protocol break with green tests.
+
+The rule is **cross-file** (``cross_file=True`` — never cached): it
+activates only on the three anchor modules and audits the whole repo
+from there, each finding landing in the file whose edit fixes it.
+
+**Anchors** (name-based, the repo's contract): the module defining
+``class Registry`` owns the metric surface; ``class EventBus`` owns the
+kind surface; a module assigning ``MAGIC = b"..."`` and building a
+``struct.Struct`` owns the wire surface. The repo root is the nearest
+ancestor directory containing ``ci.sh`` (fixture trees carry their own
+``ci.sh`` so they self-root).
+
+**Emitters** — every non-test module under the root. Extraction is
+literal-first but follows the repo's indirections: first args of
+``.counter(...)``/``.gauge(...)``/``.histogram(...)`` and
+``.emit(...)``/``_emit(...)`` calls; ``IfExp`` picks both branches
+(the ``ckpt_crc_reject``/``ckpt_reject`` pattern); ``Name`` args
+resolve through simple string bindings (``SPAN_BEGIN = "span_begin"``);
+f-strings become wildcard patterns with one-hop variable resolution
+(``stem = f"matrix_{rname}_{sched}"`` then ``f"{stem}_avg_jct"``).
+
+**Consumers** — ``ci.sh`` (raw text plus parsed ``<<'EOF'`` heredocs,
+which are pure Python in this repo), every ``tests/**.py`` (fixtures
+are skipped by the tree walk), every ``report.py`` under the root, and
+``README.md`` (consumption-witness only).
+
+**Direction A (ghost reference)**: a consumer names a metric no code
+registers — any token matching the metric grammar whose *family*
+(first ``_`` segment) is an emitted family and whose last segment is a
+known metric suffix must match an emitted literal or f-string pattern
+(a histogram registration also covers the ``_bucket``/``_count``/
+``_sum`` series the Prometheus exposition synthesizes for it).
+A kind no code emits — matched structurally (``x["kind"] == lit``,
+``.get("kind")``, ``*KINDS*`` tuples, ``for k in (...): assert k in
+kinds`` loops over a kind-set comprehension). Fires at the consumer
+line. Registration first-args inside consumer files are exempt (tests
+registering their own metrics are not references).
+
+**Direction B (orphan emission)**: an emitted literal that appears in
+no consumer text and is not allowlisted fires at the emission site.
+The allowlist is a module-level ``CONTRACT_ALLOWLIST`` tuple in the
+owning anchor module (``ast.literal_eval``'d, no import) — the
+sanctioned channel for metrics that exist for operators rather than
+gates; per-line ``# jsan: disable`` cannot cover cross-file findings.
+
+**Wire**: every ``tests/**`` assignment whose target contains
+``GOLDEN`` and whose value is a bytes literal is validated against the
+anchor: length equals ``struct.calcsize`` of the prefix format, the
+``MAGIC`` prefix matches, the version byte matches ``VERSION``. A wire
+anchor with *no* golden witness anywhere in tests fires on the
+``MAGIC`` line — pinning the bytes is the contract, not an option.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import struct
+
+from . import Rule
+from ..engine import Finding, ModuleContext, SourceFile, iter_py_files
+
+_NAME_RE = re.compile(r"[a-z][a-z0-9]*(?:_[a-z0-9]+)+")
+_HEREDOC_RE = re.compile(
+    r"<<-?\s*'?([A-Za-z_][A-Za-z0-9_]*)'?[^\n]*\n(.*?)\n\1[ \t]*$",
+    re.S | re.M)
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_EMIT_NAMES = {"emit", "_emit"}
+# last-segment gate for metric-shaped tokens, beyond suffixes derived
+# from the emitted set itself (catches a last-segment typo of a common
+# Prometheus suffix even when nothing emits that suffix yet)
+_EXTRA_SUFFIXES = {"total", "seconds", "count", "sum", "bucket", "ms"}
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers (no imports of scanned code — lint stays JAX-free)
+
+def _parse(path: str) -> ast.AST | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError, ValueError):
+        return None
+
+
+def _read(path: str) -> str:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
+def _assigned_literal(tree: ast.AST, name: str):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            if any(isinstance(t, ast.Name) and t.id == name
+                   for t in node.targets):
+                try:
+                    return ast.literal_eval(node.value), node.value
+                except ValueError:
+                    return None, None
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name) and node.target.id == name:
+                try:
+                    return ast.literal_eval(node.value), node.value
+                except ValueError:
+                    return None, None
+    return None, None
+
+
+def _str_bindings(tree: ast.AST) -> dict[str, "str | ast.JoinedStr"]:
+    """Every simple ``name = "literal"`` / ``name = f"..."`` binding in
+    the module (module level and function locals pooled — good enough
+    to resolve the SPAN_*/stem indirections without scope analysis)."""
+    out: dict[str, str | ast.JoinedStr] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            v = node.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out[node.targets[0].id] = v.value
+            elif isinstance(v, ast.JoinedStr):
+                out[node.targets[0].id] = v
+    return out
+
+
+def _fstring_pattern(node: ast.JoinedStr, bindings, depth=0) -> str | None:
+    """Regex source for an f-string emission; formatted holes become
+    ``[a-z0-9_]+`` unless a one-hop binding pins them."""
+    parts: list[str] = []
+    for v in node.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(re.escape(v.value))
+        elif isinstance(v, ast.FormattedValue):
+            sub = None
+            if depth < 2 and isinstance(v.value, ast.Name):
+                bound = bindings.get(v.value.id)
+                if isinstance(bound, str):
+                    sub = re.escape(bound)
+                elif isinstance(bound, ast.JoinedStr):
+                    sub = _fstring_pattern(bound, bindings, depth + 1)
+            parts.append(sub if sub is not None else r"[a-z0-9_]+")
+        else:
+            return None
+    return "".join(parts) or None
+
+
+def _call_attr(call: ast.Call) -> str | None:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _first_arg_names(call: ast.Call, bindings) -> tuple[list[str], list[str]]:
+    """(literals, patterns) the call's first argument can emit."""
+    if not call.args:
+        return [], []
+    arg = call.args[0]
+    lits: list[str] = []
+    pats: list[str] = []
+
+    def resolve(a: ast.AST) -> None:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            lits.append(a.value)
+        elif isinstance(a, ast.IfExp):
+            resolve(a.body)
+            resolve(a.orelse)
+        elif isinstance(a, ast.Name):
+            bound = bindings.get(a.id)
+            if isinstance(bound, str):
+                lits.append(bound)
+            elif isinstance(bound, ast.JoinedStr):
+                pat = _fstring_pattern(bound, bindings)
+                if pat:
+                    pats.append(pat)
+        elif isinstance(a, ast.JoinedStr):
+            pat = _fstring_pattern(a, bindings)
+            if pat:
+                pats.append(pat)
+
+    resolve(arg)
+    return lits, pats
+
+
+# ---------------------------------------------------------------------------
+# repo scan: emissions + consumers, memoized per root on stat signature
+
+class _Scan:
+    def __init__(self) -> None:
+        self.sig: tuple = ()
+        # name -> (path, lineno, col) of the first emission site
+        self.metric_lits: dict[str, tuple[str, int, int]] = {}
+        self.kind_lits: dict[str, tuple[str, int, int]] = {}
+        # Prometheus histograms expose derived series the exposition
+        # format synthesizes (name_bucket/_count/_sum) — consumers
+        # legitimately reference those without any matching
+        # registration literal
+        self.metric_derived: set[str] = set()
+        self.metric_pats: list[re.Pattern] = []
+        self.kind_pats: list[re.Pattern] = []
+        self.texts: dict[str, str] = {}           # path -> source text
+        # consumer python units: (path, tree, line_offset)
+        self.py_units: list[tuple[str, ast.AST, int]] = []
+        self.ci_path: str | None = None
+        self.ci_stripped: str = ""                # heredocs blanked
+        self.consumed_text: str = ""              # union for direction B
+
+
+def _find_root(path: str) -> str:
+    d = os.path.dirname(os.path.abspath(path))
+    cur = d
+    while True:
+        if os.path.isfile(os.path.join(cur, "ci.sh")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return d
+        cur = parent
+
+
+def _emitter_files(root: str) -> list[str]:
+    out = []
+    tests = os.path.join(root, "tests")
+    for p in iter_py_files([root]):
+        ap = os.path.abspath(p)
+        if ap == tests or ap.startswith(tests + os.sep):
+            continue
+        out.append(ap)
+    return out
+
+
+def _consumer_files(root: str) -> list[str]:
+    out = []
+    tests = os.path.join(root, "tests")
+    if os.path.isdir(tests):
+        out.extend(os.path.abspath(p) for p in iter_py_files([tests]))
+    for p in _emitter_files(root):
+        if os.path.basename(p) == "report.py":
+            out.append(p)
+    return out
+
+
+def _signature(root: str) -> tuple:
+    entries = []
+    for p in (_emitter_files(root) + _consumer_files(root)
+              + [os.path.join(root, "ci.sh"),
+                 os.path.join(root, "README.md")]):
+        try:
+            st = os.stat(p)
+            entries.append((p, st.st_mtime_ns, st.st_size))
+        except OSError:
+            entries.append((p, -1, -1))
+    return tuple(sorted(set(entries)))
+
+
+_SCANS: dict[str, _Scan] = {}
+
+
+def _scan(root: str) -> _Scan:
+    sig = _signature(root)
+    cached = _SCANS.get(root)
+    if cached is not None and cached.sig == sig:
+        return cached
+    scan = _Scan()
+    scan.sig = sig
+    # -- emissions ---------------------------------------------------------
+    for path in _emitter_files(root):
+        tree = _parse(path)
+        if tree is None:
+            continue
+        scan.texts[path] = _read(path)
+        bindings = _str_bindings(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            attr = _call_attr(node)
+            if attr in _REG_METHODS:
+                dst_l, dst_p = scan.metric_lits, scan.metric_pats
+            elif attr in _EMIT_NAMES:
+                dst_l, dst_p = scan.kind_lits, scan.kind_pats
+            else:
+                continue
+            lits, pats = _first_arg_names(node, bindings)
+            site = (path, node.lineno, node.col_offset)
+            for lit in lits:
+                dst_l.setdefault(lit, site)
+                if attr == "histogram":
+                    scan.metric_derived.update(
+                        f"{lit}_{d}" for d in ("bucket", "count", "sum"))
+            for pat in pats:
+                try:
+                    dst_p.append(re.compile(pat))
+                except re.error:
+                    pass
+    # -- consumers ---------------------------------------------------------
+    consumed = []
+    for path in _consumer_files(root):
+        tree = _parse(path)
+        text = _read(path)
+        scan.texts[path] = text
+        consumed.append(text)
+        if tree is not None:
+            scan.py_units.append((path, tree, 0))
+    ci = os.path.join(root, "ci.sh")
+    if os.path.isfile(ci):
+        scan.ci_path = ci
+        text = _read(ci)
+        scan.texts[ci] = text
+        consumed.append(text)
+        stripped = text
+        for m in _HEREDOC_RE.finditer(text):
+            body = m.group(2)
+            offset = text[:m.start(2)].count("\n")
+            try:
+                tree = ast.parse(body)
+            except (SyntaxError, ValueError):
+                continue
+            scan.py_units.append((ci, tree, offset))
+            # blank the heredoc body in the raw view so its tokens are
+            # not double-reported by the raw-text pass
+            stripped = (stripped[:m.start(2)]
+                        + "\n" * body.count("\n")
+                        + stripped[m.end(2):])
+        scan.ci_stripped = stripped
+    readme = os.path.join(root, "README.md")
+    if os.path.isfile(readme):
+        consumed.append(_read(readme))
+    scan.consumed_text = "\n".join(consumed)
+    _SCANS[root] = scan
+    return scan
+
+
+# ---------------------------------------------------------------------------
+# consumer-side extraction
+
+def _local_registrations(tree: ast.AST) -> tuple[set[int], set[str]]:
+    """Constant-node ids that are first args of registration/emit calls,
+    plus the literal names those calls register.  A test registering its
+    own metric is not a reference, and once registered the name exists at
+    runtime — other mentions of it in the same file are not ghosts."""
+    ids: set[int] = set()
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and _call_attr(node) in (_REG_METHODS | _EMIT_NAMES) \
+                and node.args:
+            for sub in ast.walk(node.args[0]):
+                ids.add(id(sub))
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str):
+                    names.add(sub.value)
+    return ids, names
+
+
+def _is_kind_expr(e: ast.AST) -> bool:
+    if isinstance(e, ast.Subscript):
+        s = e.slice
+        return isinstance(s, ast.Constant) and s.value == "kind"
+    if isinstance(e, ast.Call) and _call_attr(e) == "get" and e.args:
+        a = e.args[0]
+        return isinstance(a, ast.Constant) and a.value == "kind"
+    if isinstance(e, ast.Name):
+        return e.id == "kind"
+    if isinstance(e, ast.Attribute):
+        return e.attr == "kind"
+    return False
+
+
+def _str_elts(node: ast.AST) -> list[ast.Constant]:
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _kind_refs(tree: ast.AST) -> list[tuple[str, ast.AST]]:
+    """(kind, node) for every structural kind reference in a consumer."""
+    refs: list[tuple[str, ast.AST]] = []
+    kindset_vars = {"kinds"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name, value = node.targets[0].id, node.value
+            if isinstance(value, (ast.SetComp, ast.ListComp,
+                                  ast.GeneratorExp)):
+                if any(isinstance(s, ast.Constant) and s.value == "kind"
+                       for s in ast.walk(value)):
+                    kindset_vars.add(name)
+            elif "KINDS" in name.upper():
+                refs.extend((e.value, e) for e in _str_elts(value))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare) and len(node.comparators) == 1:
+            left, comp = node.left, node.comparators[0]
+            if _is_kind_expr(left):
+                if isinstance(comp, ast.Constant) \
+                        and isinstance(comp.value, str):
+                    refs.append((comp.value, comp))
+                refs.extend((e.value, e) for e in _str_elts(comp))
+            elif isinstance(node.ops[0], ast.In) \
+                    and isinstance(comp, ast.Name) \
+                    and comp.id in kindset_vars \
+                    and isinstance(left, ast.Constant) \
+                    and isinstance(left.value, str):
+                refs.append((left.value, left))
+        elif isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+            elts = _str_elts(node.iter)
+            if not elts:
+                continue
+            loops_into_kinds = any(
+                isinstance(c, ast.Compare) and len(c.ops) == 1
+                and isinstance(c.ops[0], ast.In)
+                and isinstance(c.left, ast.Name)
+                and c.left.id == node.target.id
+                and isinstance(c.comparators[0], ast.Name)
+                and c.comparators[0].id in kindset_vars
+                for b in node.body for c in ast.walk(b))
+            if loops_into_kinds:
+                refs.extend((e.value, e) for e in elts)
+    return refs
+
+
+# ---------------------------------------------------------------------------
+# findings
+
+def _display(path: str) -> str:
+    rel = os.path.relpath(path)
+    if rel.startswith(".."):
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def _line_of(text: str, lineno: int) -> str:
+    lines = text.splitlines()
+    if 1 <= lineno <= len(lines):
+        return lines[lineno - 1].strip()
+    return ""
+
+
+def _xfinding(scan: _Scan, path: str, line: int, col: int,
+              message: str) -> Finding:
+    snippet = _line_of(scan.texts.get(path, ""), line)
+    return Finding(path=_display(path), line=line, col=col,
+                   rule=RULE.name, message=message, snippet=snippet,
+                   end_line=line, end_col=max(col + 1, len(snippet)))
+
+
+def _allowlist(tree: ast.AST) -> set[str]:
+    value, _ = _assigned_literal(tree, "CONTRACT_ALLOWLIST")
+    if isinstance(value, (tuple, list, set)):
+        return {v for v in value if isinstance(v, str)}
+    return set()
+
+
+def _check_metrics(src: SourceFile, ctx: ModuleContext,
+                   scan: _Scan) -> list[Finding]:
+    allow = _allowlist(ctx.tree)
+    families = {n.split("_", 1)[0] for n in scan.metric_lits}
+    families |= {p.pattern.split("_", 1)[0] for p in scan.metric_pats
+                 if not p.pattern.startswith("[")}
+    suffixes = ({n.rsplit("_", 1)[-1] for n in scan.metric_lits}
+                | _EXTRA_SUFFIXES)
+
+    def known(tok: str) -> bool:
+        return (tok in scan.metric_lits or tok in scan.metric_derived
+                or tok in allow
+                or any(p.fullmatch(tok) for p in scan.metric_pats))
+
+    def gated(tok: str) -> bool:
+        return (tok.split("_", 1)[0] in families
+                and tok.rsplit("_", 1)[-1] in suffixes)
+
+    findings: list[Finding] = []
+    seen: set[tuple[str, int, str]] = set()
+
+    def ghost(path: str, line: int, col: int, tok: str) -> None:
+        if (path, line, tok) in seen:
+            return
+        seen.add((path, line, tok))
+        findings.append(_xfinding(
+            scan, path, line, col,
+            f"consumer references metric {tok!r} but no code registers "
+            f"it: the grep/assert matches nothing and passes or fails "
+            f"vacuously — fix the name, register the metric, or add it "
+            f"to CONTRACT_ALLOWLIST in the Registry module"))
+
+    # direction A: raw ci.sh tokens (heredocs handled as python below)
+    if scan.ci_path is not None:
+        for i, raw in enumerate(scan.ci_stripped.splitlines(), start=1):
+            for m in _NAME_RE.finditer(raw):
+                tok = m.group(0)
+                if gated(tok) and not known(tok):
+                    ghost(scan.ci_path, i, m.start(), tok)
+    # direction A: string constants in consumer python units
+    for path, tree, offset in scan.py_units:
+        skip, local = _local_registrations(tree)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)) or id(node) in skip:
+                continue
+            for m in _NAME_RE.finditer(node.value):
+                tok = m.group(0)
+                if gated(tok) and tok not in local and not known(tok):
+                    ghost(path, offset + node.lineno,
+                          node.col_offset, tok)
+    # direction B: orphan registrations
+    for name, (path, line, col) in sorted(scan.metric_lits.items()):
+        if name in allow or name in scan.consumed_text:
+            continue
+        findings.append(_xfinding(
+            scan, path, line, col,
+            f"metric {name!r} is registered but no ci.sh stage, test, "
+            f"report consumer, or README mentions it: either wire a "
+            f"gate/doc to it or add it to CONTRACT_ALLOWLIST in the "
+            f"Registry module to mark it operator-only"))
+    return findings
+
+
+def _check_kinds(src: SourceFile, ctx: ModuleContext,
+                 scan: _Scan) -> list[Finding]:
+    allow = _allowlist(ctx.tree)
+
+    def known(kind: str) -> bool:
+        return (kind in scan.kind_lits or kind in allow
+                or any(p.fullmatch(kind) for p in scan.kind_pats))
+
+    findings: list[Finding] = []
+    seen: set[tuple[str, int, str]] = set()
+    for path, tree, offset in scan.py_units:
+        _, local = _local_registrations(tree)
+        for kind, node in _kind_refs(tree):
+            if known(kind) or kind in local:
+                continue
+            line = offset + getattr(node, "lineno", 1)
+            if (path, line, kind) in seen:
+                continue
+            seen.add((path, line, kind))
+            findings.append(_xfinding(
+                scan, path, line, getattr(node, "col_offset", 0),
+                f"consumer matches event kind {kind!r} but no code "
+                f"emits it: the gate asserts against a kind that can "
+                f"never arrive — fix the name, emit the kind, or add "
+                f"it to CONTRACT_ALLOWLIST in the EventBus module"))
+    for kind, (path, line, col) in sorted(scan.kind_lits.items()):
+        if kind in allow or kind in scan.consumed_text:
+            continue
+        findings.append(_xfinding(
+            scan, path, line, col,
+            f"event kind {kind!r} is emitted but no ci.sh gate, test, "
+            f"or report consumer matches it: either assert on it "
+            f"somewhere or add it to CONTRACT_ALLOWLIST in the "
+            f"EventBus module to mark it operator-only"))
+    return findings
+
+
+def _check_wire(src: SourceFile, ctx: ModuleContext, scan: _Scan,
+                root: str) -> list[Finding]:
+    magic_val, magic_node = _assigned_literal(ctx.tree, "MAGIC")
+    if not isinstance(magic_val, bytes) or magic_node is None:
+        return []
+    version_val, _ = _assigned_literal(ctx.tree, "VERSION")
+    fmt = None
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _call_attr(node) == "Struct" \
+                and node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            fmt = node.args[0].value
+            break
+    try:
+        size = struct.calcsize(fmt) if fmt else None
+    except struct.error:
+        size = None
+
+    goldens: list[tuple[str, int, int, str, bytes]] = []
+    tests = os.path.join(root, "tests")
+    if os.path.isdir(tests):
+        for path in iter_py_files([tests]):
+            tree = _parse(path)
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and "GOLDEN" in node.targets[0].id \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, bytes):
+                    scan.texts.setdefault(path, _read(path))
+                    goldens.append((os.path.abspath(path), node.lineno,
+                                    node.col_offset, node.targets[0].id,
+                                    node.value.value))
+    findings: list[Finding] = []
+    if not goldens:
+        findings.append(src.finding(
+            magic_node, RULE.name,
+            f"wire frame constants (MAGIC={magic_val!r}) have no "
+            f"golden-bytes witness: no tests/** assignment pins the "
+            f"exact frame prefix as a bytes literal (a *GOLDEN* name), "
+            f"so a format edit ships a protocol break with green tests "
+            f"— pin the prefix bytes in a test"))
+        return findings
+    for path, line, col, name, value in goldens:
+        errs = []
+        if size is not None and len(value) != size:
+            errs.append(f"length {len(value)} != struct prefix size "
+                        f"{size} ({fmt!r})")
+        if not value.startswith(magic_val):
+            errs.append(f"does not start with MAGIC {magic_val!r}")
+        elif isinstance(version_val, int) and len(value) > len(magic_val) \
+                and value[len(magic_val)] != version_val:
+            errs.append(f"version byte {value[len(magic_val)]} != "
+                        f"VERSION {version_val}")
+        if errs:
+            scan.texts.setdefault(path, _read(path))
+            findings.append(_xfinding(
+                scan, path, line, col,
+                f"golden wire bytes {name} disagree with the frame "
+                f"constants: {'; '.join(errs)} — the pinned prefix and "
+                f"the wire module must change together"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# anchors
+
+def _has_class(tree: ast.AST, name: str, methods: set[str]) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            defined = {n.name for n in node.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            if methods <= defined:
+                return True
+    return False
+
+
+def _is_wire_anchor(tree: ast.AST) -> bool:
+    magic, _ = _assigned_literal(tree, "MAGIC")
+    if not isinstance(magic, bytes):
+        return False
+    return any(isinstance(n, ast.Call) and _call_attr(n) == "Struct"
+               for n in ast.walk(tree))
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    is_metrics = _has_class(ctx.tree, "Registry", _REG_METHODS)
+    is_events = _has_class(ctx.tree, "EventBus", {"emit"})
+    is_wire = _is_wire_anchor(ctx.tree)
+    if not (is_metrics or is_events or is_wire):
+        return []
+    root = _find_root(src.path)
+    scan = _scan(root)
+    findings: list[Finding] = []
+    if is_metrics:
+        findings.extend(_check_metrics(src, ctx, scan))
+    if is_events:
+        findings.extend(_check_kinds(src, ctx, scan))
+    if is_wire:
+        findings.extend(_check_wire(src, ctx, scan, root))
+    return findings
+
+
+RULE = Rule(
+    name="contract-drift",
+    summary="metric/kind/wire names out of lockstep between emitters "
+            "and their ci.sh, test, and report consumers",
+    check=_check,
+    cross_file=True)

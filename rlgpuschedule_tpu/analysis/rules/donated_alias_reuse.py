@@ -1,0 +1,55 @@
+"""donated-alias-reuse: touching a host alias after its buffer donated.
+
+``jax.jit(..., donate_argnums=...)`` is the Podracer memory trick this
+repo leans on at every dispatch boundary (serve engine, async rollout/
+learn, fused update steps): XLA reuses the donated input's pages for
+the outputs. The flip side is a contract on the CALLER: after the
+dispatch, the Python name passed at a donated position refers to a
+deleted buffer. Reading it does not reliably raise — on some backends
+it returns whatever the output computation left in those pages, which
+is exactly the silent-corruption class ``checkpoint._fresh_copy``
+documents for restored trees.
+
+The blessed idiom rebinds through the dispatch — ``state =
+self._step(state, batch)`` — which this rule recognizes: a name that
+the donating call's own assignment rebinds is never flagged. What fires
+is the alias that survives: dispatch WITHOUT rebinding the donated
+name, then any later read of it on the same control-flow path (logging
+the old state, re-dispatching it, computing a metric from it).
+
+Donation positions come from the ``donate_argnums`` literal on the
+tracked ``jax.jit`` site (the concurrency model carries compiled/
+donated-ness through one assignment hop); splatted call sites
+(``self._step(*args)``) are skipped — positions are unknowable there,
+and the engine's warmup/steady split owns that discipline at runtime.
+The sibling rule ``donation-cross-thread`` covers the two-thread
+version of this hazard; this one is the same-frame version.
+"""
+from __future__ import annotations
+
+from . import Rule
+from ..engine import Finding, ModuleContext, SourceFile
+from ..lifetime import model_for
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    model = model_for(ctx)
+    findings: list[Finding] = []
+    for use in model.donated_uses:
+        dispatch_line = getattr(use.dispatch, "lineno", 0)
+        findings.append(src.finding(
+            use.node, RULE.name,
+            f"{use.name!r} was donated to the jitted dispatch on line "
+            f"{dispatch_line} (donate_argnums) and read again here: "
+            f"its buffer now backs the outputs, so the read returns "
+            f"garbage without raising — rebind the result over the "
+            f"donated name (state = step(state)) or keep a pre-"
+            f"dispatch copy"))
+    return findings
+
+
+RULE = Rule(
+    name="donated-alias-reuse",
+    summary="host reads of a name after it was passed at a "
+            "donate_argnums position of a jitted dispatch",
+    check=_check)

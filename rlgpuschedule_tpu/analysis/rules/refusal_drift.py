@@ -255,4 +255,5 @@ RULE = Rule(
     summary="MODE_REFUSALS rows without a reachable guard; CLI guards "
             "with unknown modes, ad-hoc refusals, or unguarded "
             "refusable flag pairs",
-    check=_check)
+    check=_check,
+    cross_file=True)

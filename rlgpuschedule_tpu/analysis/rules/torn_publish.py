@@ -1,0 +1,55 @@
+"""torn-publish: handing a live slab view to another thread.
+
+The arena block has exactly one sanctioned cross-thread handoff: the
+submit path memcpys the request INTO the slab outside the lock, then
+publishes the row with a GIL-atomic ``published[i] = True`` flag the
+pump checks before sealing (``serve/batching.py``). Anything else that
+moves a slab/frombuffer view across a thread boundary — a ``.put()``
+onto a queue the dispatcher drains, an executor ``submit`` closing over
+the view, a ``Thread(target=...)`` capturing it — publishes memory
+whose lifetime the receiving thread cannot see: the sender's frame
+recycles the block on its own schedule, and the reader observes half of
+batch N and half of batch N+1 (a torn read), or a fully foreign batch.
+
+Fires, composing the lifetime model with the concurrency model's
+thread roots, when a module that visibly runs threads publishes a
+strong view through:
+
+- ``queue.put(view)`` / ``put_nowait(view)`` (directly or inside a
+  tuple/list payload);
+- ``executor.submit(fn_or_lambda_closing_over_view)``;
+- a ``Thread`` target closure capturing the view.
+
+Modules with no thread roots never fire — a single-threaded pipeline
+putting views on a local work list is lifetime-safe. The fix is to
+publish a copy (``view.copy()`` ends the taint chain) or restructure so
+the consumer reads the slab under the arena's published-flag protocol.
+"""
+from __future__ import annotations
+
+from . import Rule
+from ..engine import Finding, ModuleContext, SourceFile
+from ..lifetime import model_for
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    model = model_for(ctx)
+    if not model.cmodel.thread_roots:
+        return []
+    findings: list[Finding] = []
+    for pub in model.publishes:
+        findings.append(src.finding(
+            pub.node, RULE.name,
+            f"{pub.view.label} view published to another thread via "
+            f"{pub.channel}: the receiver cannot see the buffer's "
+            f"recycle schedule, so it reads torn or foreign batches — "
+            f"publish a copy, or hand off through the arena's "
+            f"published-flag protocol"))
+    return findings
+
+
+RULE = Rule(
+    name="torn-publish",
+    summary="slab/frombuffer views handed across threads via queues, "
+            "executors, or Thread closures outside the arena protocol",
+    check=_check)

@@ -1,0 +1,52 @@
+"""use-after-recycle: reading a view after its storage was reclaimed.
+
+The arena pump's contract is strictly ordered: take a block, build slab
+views, dispatch, scatter, resolve futures, THEN ``ring.recycle(blk)``.
+Recycling hands the slab to the next batch's memcpys — any read of the
+block (or a view derived from it) after that point races the producer
+and returns torn or foreign rows. The same shape exists on the wire
+path: ``np.frombuffer(buf)`` views die the moment the next
+``recv_into(buf)`` / ``readinto(buf)`` lands in the same buffer object
+(rebinding ``buf = sock.recv(n)`` is safe — the old bytes object stays
+alive under the old view; in-place reuse is not).
+
+Fires on every use the lifetime model (:mod:`..lifetime`) proves is
+reachable after the kill point on the same control-flow path:
+
+- a strong view (provable alias of the block / buffer): ANY use after
+  the kill — subscript, call argument, return, iteration;
+- a weak value (an opaque helper's result seeded by the block, e.g. a
+  row count): only a data dereference (subscript/attribute) fires, so
+  returning a count after the recycle stays clean.
+
+Control flow is respected: a recycle inside an ``except`` handler that
+re-raises does not poison the happy path after the ``try``. The fix is
+to move the read before the kill, or copy what must survive it.
+"""
+from __future__ import annotations
+
+from . import Rule
+from ..engine import Finding, ModuleContext, SourceFile
+from ..lifetime import model_for
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    model = model_for(ctx)
+    findings: list[Finding] = []
+    for use in model.dead_uses:
+        kill_line = getattr(use.kill, "lineno", 0)
+        findings.append(src.finding(
+            use.node, RULE.name,
+            f"use of {use.view.label} view after its storage was "
+            f"reclaimed by `{use.kill_label}` (line {kill_line}): the "
+            f"slab/buffer now belongs to the next batch, so this read "
+            f"returns torn or foreign data — move the read before the "
+            f"recycle, or copy what must survive it"))
+    return findings
+
+
+RULE = Rule(
+    name="use-after-recycle",
+    summary="reads of slab/frombuffer views reachable after their "
+            "block recycle / buffer reuse point",
+    check=_check)

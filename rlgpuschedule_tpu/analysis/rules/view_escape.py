@@ -1,0 +1,63 @@
+"""view-escape: a slab/frombuffer view outliving its function frame.
+
+The arena data plane (``serve/batching.py``, ISSUE 17) trades copies
+for aliasing discipline: ``_arena_views`` returns slices of a recycled
+slab, ``scatter_results`` returns rows of one batched actions buffer,
+and the HTTP front door parses requests as ``np.frombuffer`` views over
+the received body. All of that is correct ONLY while the view stays
+inside the frame that knows the buffer's lifetime. The moment a view is
+*stored* — on ``self``, in a module-level container, inside a returned
+closure — its backing storage can be recycled (or the recv buffer
+reused) under it, and the reader sees someone else's batch with no
+exception anywhere near the bug.
+
+Fires on every escape of a strong view the lifetime model
+(:mod:`..lifetime`) proves aliases a tracked source:
+
+- stored on a ``self`` attribute or appended/inserted into a ``self``
+  container (or a module-level global);
+- returned — UNLESS the function's docstring documents the view
+  contract (contains the word "view"), which is this repo's convention
+  for deliberate zero-copy returns (``_arena_views``: "(views, never
+  copies)"); an undocumented view return is indistinguishable from an
+  accidental one at every call site;
+- captured by a nested function that is itself returned or stored.
+
+The fix is one of: copy at the boundary (``view.copy()`` /
+``np.array(view)`` end the taint chain), or document the contract in
+the docstring so callers know they hold borrowed memory.
+"""
+from __future__ import annotations
+
+from . import Rule
+from ..engine import Finding, ModuleContext, SourceFile
+from ..lifetime import model_for
+
+
+def _check(src: SourceFile, ctx: ModuleContext) -> list[Finding]:
+    model = model_for(ctx)
+    findings: list[Finding] = []
+    for esc in model.escapes:
+        if esc.how == "returned" and esc.documented:
+            continue
+        if esc.how == "returned":
+            hint = ("return a copy (view.copy() / np.array(view)) or "
+                    "document the zero-copy contract in the docstring "
+                    "(the word 'view' marks it, like _arena_views)")
+        else:
+            hint = ("copy at the boundary — the stored reference "
+                    "outlives the frame that knows the buffer's "
+                    "lifetime")
+        findings.append(src.finding(
+            esc.node, RULE.name,
+            f"{esc.view.label} view {esc.how}: the backing buffer can "
+            f"be recycled under it and the holder reads another "
+            f"batch's data — {hint}"))
+    return findings
+
+
+RULE = Rule(
+    name="view-escape",
+    summary="slab/frombuffer/scatter views stored beyond their frame "
+            "or returned without a documented view contract",
+    check=_check)

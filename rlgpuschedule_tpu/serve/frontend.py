@@ -374,14 +374,17 @@ class ServeFrontend:
         return method, path, headers, body
 
     def _parse_body(self, body: bytes) -> "tuple[Any, Any]":
+        """Decode (obs, mask) as read-only **views** over ``body`` —
+        never copies; the first copy is the batch stack, same as an
+        in-process submit. The views are only safe while ``body`` is
+        alive, which submit guarantees by memcpying into the arena slab
+        before this frame returns."""
         expected = self._obs_nbytes + self._mask_nbytes
         if len(body) != expected:
             raise _BadRequest(
                 f"body must be exactly {expected} bytes "
                 f"(obs {self._obs_shape} {self._obs_dtype} + mask "
                 f"{self._mask_shape} {self._mask_dtype}), got {len(body)}")
-        # zero-copy: read-only views over the received bytes; the first
-        # copy is the batch stack, same as an in-process submit
         obs = np.frombuffer(
             body, dtype=self._obs_dtype,
             count=int(np.prod(self._obs_shape, dtype=np.int64)),

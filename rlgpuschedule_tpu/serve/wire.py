@@ -155,7 +155,9 @@ def recv_frame(sock: socket.socket) -> "tuple[int, bytes, bytes, int, int]":
 
 def unpack_action(header: bytes, body: bytes) -> np.ndarray:
     """Decode a KIND_RESP payload back into the action array (client
-    side). The descriptor grammar is ``dtype:(shape)``."""
+    side). The descriptor grammar is ``dtype:(shape)``. Returns a
+    read-only **view** over ``body`` (zero-copy — ``bytes`` is
+    immutable and the view keeps it alive, so no copy is needed)."""
     try:
         dtype_name, _, shape_s = header.decode("ascii").partition(":")
         shape = tuple(int(d) for d in

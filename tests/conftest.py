@@ -29,6 +29,11 @@ from rlgpuschedule_tpu.utils.platform import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()
 
+# jsan's fixture corpus is deliberately-broken code, and the contract-drift
+# directory fixtures carry their own tests/test_*.py as analysis INPUT —
+# never collect any of it as real tests.
+collect_ignore = ["fixtures"]
+
 import pytest  # noqa: E402
 
 

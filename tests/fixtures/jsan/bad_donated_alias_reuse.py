@@ -1,0 +1,25 @@
+"""Known-bad: host reads of donated names after dispatch (2 findings).
+
+``donate_argnums=(0,)`` lets XLA reuse ``state``'s pages for the
+outputs — after the dispatch the Python name refers to a deleted
+buffer, and reading it returns garbage without raising.
+"""
+import jax
+
+
+def _decide(state, batch):
+    return state + batch
+
+
+class Engine:
+    def __init__(self):
+        self._step = jax.jit(_decide, donate_argnums=(0,))
+
+    def run(self, state, batch):
+        new = self._step(state, batch)
+        stale = state.mean()           # finding: read after donation
+        return new, stale
+
+    def double_dispatch(self, state, batch):
+        self._step(state, batch)
+        return self._step(state, batch)   # finding: re-dispatch donated

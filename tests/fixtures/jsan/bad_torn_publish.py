@@ -1,0 +1,35 @@
+"""Known-bad: live slab/frombuffer views handed across threads
+(2 findings).
+
+The receiving thread cannot see the sender's recycle schedule — it
+reads half of batch N and half of batch N+1, or a foreign batch.
+"""
+import queue
+import threading
+
+import numpy as np
+
+
+class Fanout:
+    def __init__(self, ring):
+        self.ring = ring
+        self.q = queue.Queue()
+
+    def pump_loop(self):
+        blk = self.ring.take_block()
+        rows = blk.obs[:8]
+        self.q.put(rows)               # finding: live view across threads
+        self.ring.recycle(blk)
+
+    def offload(self, pool, buf):
+        view = np.frombuffer(buf, dtype=np.float32)
+        pool.submit(self._consume, view)   # finding: view into executor
+        return len(buf)
+
+    def _consume(self, arr):
+        return arr.sum()
+
+    def start(self):
+        t = threading.Thread(target=self.pump_loop)
+        t.start()
+        return t

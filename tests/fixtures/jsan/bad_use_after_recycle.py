@@ -1,0 +1,33 @@
+"""Known-bad: reads reachable after the storage's kill point
+(3 findings).
+
+After ``ring.recycle(blk)`` the slab belongs to the next batch; after
+``recv_into(buf)`` the old ``frombuffer`` view maps the new message.
+"""
+import numpy as np
+
+
+class Pump:
+    def __init__(self, ring):
+        self.ring = ring
+
+    def pump(self, n):
+        blk = self.ring.take_block()
+        rows = blk.obs[:n]
+        total = rows.sum()
+        self.ring.recycle(blk)
+        top = float(rows[0])           # finding: strong use after recycle
+        return total, top
+
+    def weak_leak(self, summarize):
+        blk = self.ring.take_block()
+        info = summarize(blk)          # opaque helper: weak taint
+        self.ring.recycle(blk)
+        return info["rows"]            # finding: weak deref after recycle
+
+
+def drain(sock, buf):
+    view = np.frombuffer(buf, dtype=np.uint8)
+    first = int(view[0])
+    sock.recv_into(buf)                # in-place reuse kills the view
+    return first, int(view[1])         # finding: deref after recv_into

@@ -1,0 +1,27 @@
+"""Known-bad: slab/frombuffer views escaping their frame (4 findings).
+
+Every escape hands borrowed memory to a holder that cannot see the
+arena's recycle schedule: a later batch rewrites the slab under the
+stored/returned view.
+"""
+import numpy as np
+
+_STASH = []
+
+
+class Pump:
+    def __init__(self, ring):
+        self.ring = ring
+        self.last_rows = None
+
+    def pump(self, n):
+        blk = self.ring.take_block()
+        rows = blk.obs[:n]
+        self.last_rows = rows          # finding: stored on self
+        _STASH.append(rows)            # finding: module-global container
+        return blk                     # finding: returned, no contract
+
+
+def parse(buf, shape):
+    arr = np.frombuffer(buf, dtype=np.float32)
+    return arr.reshape(shape)          # finding: returned, no contract

@@ -1,0 +1,11 @@
+"""Fixture event surface: the ``class EventBus`` anchor."""
+
+
+class EventBus:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **fields):
+        event = {"kind": kind, **fields}
+        self.events.append(event)
+        return event

@@ -1,0 +1,19 @@
+"""Fixture metric surface: the ``class Registry`` anchor contract-drift
+audits from (no allowlist here — everything must be consumed)."""
+
+
+class Registry:
+    def __init__(self):
+        self.names = []
+
+    def counter(self, name, help=""):
+        self.names.append(name)
+        return name
+
+    def gauge(self, name, help=""):
+        self.names.append(name)
+        return name
+
+    def histogram(self, name, help="", buckets=()):
+        self.names.append(name)
+        return name

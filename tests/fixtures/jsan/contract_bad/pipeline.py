@@ -1,0 +1,16 @@
+"""Fixture emitter: registers metrics and emits kinds the consumers
+reference (or fail to)."""
+from events import EventBus
+from metrics import Registry
+
+
+def run(n):
+    reg = Registry()
+    bus = EventBus()
+    rows = reg.counter("pipe_rows_total", "rows processed")
+    dropped = reg.counter("pipe_dropped_total",   # orphan: consumed nowhere
+                          "rows dropped")
+    for i in range(n):
+        bus.emit("step_done", step=i)
+        bus.emit("debug_tick", step=i)            # orphan: consumed nowhere
+    return rows, dropped

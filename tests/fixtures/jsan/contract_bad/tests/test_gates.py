@@ -1,0 +1,10 @@
+"""Fixture consumer: one ghost kind reference and a stale golden pin."""
+
+# wrong magic: wire.py says b"PBIN" version 2 — this pin predates both
+GOLDEN_ROW_PREFIX = b"XBIN\x01\x01\x04\x00"
+
+
+def test_step_events(events):
+    assert any(e["kind"] == "step_done" for e in events)
+    # ghost: nothing emits "step_finished" (renamed to step_done)
+    assert not any(e["kind"] == "step_finished" for e in events)

@@ -1,0 +1,13 @@
+"""Fixture wire surface: MAGIC + struct prefix anchor."""
+import struct
+
+MAGIC = b"PBIN"
+VERSION = 2
+KIND_ROW = 1
+
+PREFIX = struct.Struct("<4sBBH")     # magic, version, kind, length
+PREFIX_SIZE = PREFIX.size            # 8 bytes
+
+
+def pack_row(kind, payload):
+    return PREFIX.pack(MAGIC, VERSION, kind, len(payload)) + payload

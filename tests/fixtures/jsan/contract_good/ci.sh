@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+set -euo pipefail
+
+python pipeline.py --out out.prom
+grep -q "pipe_rows_total" out.prom
+
+python - out.jsonl <<'EOF'
+import json
+import sys
+
+events = [json.loads(line) for line in open(sys.argv[1])]
+kinds = {e["kind"] for e in events}
+for k in ("step_done",):
+    assert k in kinds
+EOF

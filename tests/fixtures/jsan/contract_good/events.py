@@ -1,0 +1,15 @@
+"""Fixture event surface (good twin): anchor + allowlisted kind."""
+
+CONTRACT_ALLOWLIST = (
+    "debug_tick",              # developer breadcrumb, nothing gates it
+)
+
+
+class EventBus:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **fields):
+        event = {"kind": kind, **fields}
+        self.events.append(event)
+        return event

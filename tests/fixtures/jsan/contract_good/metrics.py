@@ -1,0 +1,23 @@
+"""Fixture metric surface (good twin): anchor + allowlist — the
+sanctioned channel for operator-only metrics no gate consumes."""
+
+CONTRACT_ALLOWLIST = (
+    "pipe_ops_seconds",        # operator dashboard only, no CI gate
+)
+
+
+class Registry:
+    def __init__(self):
+        self.names = []
+
+    def counter(self, name, help=""):
+        self.names.append(name)
+        return name
+
+    def gauge(self, name, help=""):
+        self.names.append(name)
+        return name
+
+    def histogram(self, name, help="", buckets=()):
+        self.names.append(name)
+        return name

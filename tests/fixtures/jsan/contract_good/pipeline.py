@@ -1,0 +1,17 @@
+"""Fixture emitter (good twin): every emission is either consumed or
+allowlisted; the f-string registration resolves to a pattern."""
+from events import EventBus
+from metrics import Registry
+
+
+def run(n, phase):
+    reg = Registry()
+    bus = EventBus()
+    rows = reg.counter("pipe_rows_total", "rows processed")
+    dropped = reg.counter("pipe_dropped_total", "rows dropped")
+    reg.gauge("pipe_ops_seconds", "op wall time")     # allowlisted
+    reg.counter(f"pipe_phase_{phase}_total", "per-phase rows")
+    for i in range(n):
+        bus.emit("step_done", step=i)
+        bus.emit("debug_tick", step=i)                # allowlisted
+    return rows, dropped

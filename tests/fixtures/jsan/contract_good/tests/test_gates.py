@@ -1,0 +1,21 @@
+"""Fixture consumer (good twin): every reference names a live emission;
+the local registration is exempt from the ghost check."""
+from metrics import Registry
+
+# matches wire.py exactly: MAGIC b"PBIN", VERSION 2, KIND_ROW, len 4
+GOLDEN_ROW_PREFIX = b"PBIN\x02\x01\x04\x00"
+
+
+def test_step_events(events):
+    assert any(e["kind"] == "step_done" for e in events)
+
+
+def test_dropped_counter(prom_text):
+    assert "pipe_dropped_total 0" in prom_text
+    # per-phase counters come from the f-string registration
+    assert "pipe_phase_warmup_total" in prom_text
+
+
+def test_local_registry_is_not_a_reference():
+    reg = Registry()
+    assert reg.counter("pipe_fixture_total") == "pipe_fixture_total"

@@ -1,0 +1,14 @@
+"""Fixture wire surface (good twin): same anchor, pinned by a correct
+golden in tests/."""
+import struct
+
+MAGIC = b"PBIN"
+VERSION = 2
+KIND_ROW = 1
+
+PREFIX = struct.Struct("<4sBBH")     # magic, version, kind, length
+PREFIX_SIZE = PREFIX.size            # 8 bytes
+
+
+def pack_row(kind, payload):
+    return PREFIX.pack(MAGIC, VERSION, kind, len(payload)) + payload

@@ -1,0 +1,23 @@
+"""Known-good twin of bad_donated_alias_reuse (0 findings): the rebind
+idiom threads the donated name through the dispatch, and anything that
+must survive is copied out before it."""
+import jax
+import jax.numpy as jnp
+
+
+def _decide(state, batch):
+    return state + batch
+
+
+class Engine:
+    def __init__(self):
+        self._step = jax.jit(_decide, donate_argnums=(0,))
+
+    def run(self, state, batch):
+        state = self._step(state, batch)   # rebind THROUGH the dispatch
+        return state, state.mean()         # reads the new buffer
+
+    def run_keeping_snapshot(self, state, batch):
+        snapshot = jnp.array(state)        # pre-dispatch copy survives
+        state = self._step(state, batch)
+        return state, snapshot.mean()

@@ -1,0 +1,43 @@
+"""Known-good twin of bad_use_after_recycle (0 findings): reads happen
+before the kill, a handler-path recycle does not poison the happy path,
+weak results are only returned (never dereferenced), and rebinding
+``buf = sock.recv(n)`` keeps the old bytes alive under the old view."""
+import numpy as np
+
+
+class Pump:
+    def __init__(self, ring):
+        self.ring = ring
+
+    def pump(self, n):
+        blk = self.ring.take_block()
+        rows = blk.obs[:n]
+        top = float(rows[0])           # materialized BEFORE the recycle
+        total = rows.sum()
+        self.ring.recycle(blk)
+        return total, top
+
+    def pump_with_fault_path(self, n, dispatch):
+        blk = self.ring.take_block()
+        rows = blk.obs[:n]
+        try:
+            dispatch(rows)
+        except RuntimeError:
+            self.ring.recycle(blk)     # error path only, then re-raise
+            raise
+        first = float(rows[0])         # happy path: still live
+        self.ring.recycle(blk)
+        return first
+
+    def weak_count(self, summarize):
+        blk = self.ring.take_block()
+        n_live = summarize(blk)        # weak: a count, not a view
+        self.ring.recycle(blk)
+        return n_live                  # no deref -> clean
+
+
+def drain(sock, n):
+    buf = sock.recv(n)
+    view = np.frombuffer(buf, dtype=np.uint8)
+    buf = sock.recv(n)                 # REBIND: old bytes stays alive
+    return int(view[0]), buf

@@ -1,22 +1,29 @@
-"""jsan static-analyzer tests (PR 3, extended by PR 15): one known-good
-+ known-bad fixture pair per rule, the thread-aware concurrency rules
-and the refusal-matrix drift checker, suppression + baseline workflows
-(including --prune-baseline / --fail-stale), JSON + SARIF output,
---diff / --explain, the exit-code contract, and the acceptance gates —
-the shipped tree is clean with an EMPTY baseline, and seeding any
-known-bad snippet into a tree makes the CLI exit nonzero.
+"""jsan static-analyzer tests (PR 3, extended by PRs 15 and 18): one
+known-good + known-bad fixture pair per rule, the thread-aware
+concurrency rules, the refusal-matrix drift checker, the value-lifetime
+rules (view-escape / use-after-recycle / donated-alias-reuse /
+torn-publish), the cross-surface contract-drift checker, the --cache
+incremental mode, suppression + baseline workflows (including
+--prune-baseline / --fail-stale), JSON + SARIF output (now with column
+regions), --diff / --explain, the exit-code contract, and the
+acceptance gates — the shipped tree is clean with an EMPTY baseline,
+and seeding any known-bad snippet into a tree makes the CLI exit
+nonzero.
 """
 import json
 import os
 import shutil
 import subprocess
 import sys
+import time
 
 import pytest
 
 from rlgpuschedule_tpu.analysis import (analyze_paths, apply_baseline,
                                         make_baseline)
-from rlgpuschedule_tpu.analysis.engine import SKIP_DIRS, iter_py_files
+from rlgpuschedule_tpu.analysis.engine import (FindingCache, SKIP_DIRS,
+                                               analyze_file,
+                                               iter_py_files)
 from rlgpuschedule_tpu.analysis.rules import rule_names
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -41,6 +48,11 @@ BAD = {
     "hung-future": ("bad_hung_future.py", 3),
     "alloc-in-hot-loop": ("bad_alloc_in_hot_loop.py", 3),
     "refusal-drift": (os.path.join("refusal_bad", "train.py"), 2),
+    "view-escape": ("bad_view_escape.py", 4),
+    "use-after-recycle": ("bad_use_after_recycle.py", 3),
+    "donated-alias-reuse": ("bad_donated_alias_reuse.py", 2),
+    "torn-publish": ("bad_torn_publish.py", 2),
+    "contract-drift": ("contract_bad", 5),   # directory fixture
 }
 GOOD = ["good_donation.py", "good_host_sync.py", "good_tracer_leak.py",
         "good_impure.py", "good_recompile.py", "good_prng_reuse.py",
@@ -54,7 +66,10 @@ GOOD = ["good_donation.py", "good_host_sync.py", "good_tracer_leak.py",
         "good_hung_future.py",
         "good_alloc_in_hot_loop.py",
         os.path.join("refusal_good", "configs.py"),
-        os.path.join("refusal_good", "train.py")]
+        os.path.join("refusal_good", "train.py"),
+        "good_view_escape.py", "good_use_after_recycle.py",
+        "good_donated_alias_reuse.py", "good_torn_publish.py",
+        "contract_good"]                       # directory fixture
 
 
 def _cli(*args, cwd=REPO):
@@ -317,6 +332,99 @@ class TestRefusalDrift:
         assert [f for f in findings if f.rule == "refusal-drift"] == []
 
 
+class TestContractDrift:
+    """Cross-surface contract checker: the bad fixture tree drifts in
+    all five ways (ghost metric, orphan metric, ghost kind, orphan
+    kind, stale wire golden); the good twin exercises the allowlist,
+    the f-string registration pattern, and the local-registration
+    exemption and stays clean."""
+
+    @pytest.mark.parametrize("needle,tail", [
+        ("no code registers it", "ci.sh"),            # ghost metric
+        ("'pipe_dropped_total' is registered", "pipeline.py"),  # orphan
+        ("no code emits it", "test_gates.py"),        # ghost kind
+        ("'debug_tick' is emitted", "pipeline.py"),   # orphan kind
+        ("disagree with the frame constants", "test_gates.py"),  # wire
+    ])
+    def test_bad_tree_drifts_in_each_family(self, needle, tail):
+        findings = analyze_paths(
+            [os.path.join(FIXTURES, "contract_bad")])
+        hits = [f for f in findings if needle in f.message]
+        assert len(hits) == 1, findings
+        assert hits[0].path.replace(os.sep, "/").endswith(tail)
+        assert hits[0].rule == "contract-drift"
+
+    def test_fixture_tree_self_roots_at_its_own_ci_sh(self):
+        """The root walk stops at the fixture's own ci.sh — nothing
+        from the real repo's surfaces leaks into fixture verdicts."""
+        findings = analyze_paths(
+            [os.path.join(FIXTURES, "contract_bad")])
+        assert findings
+        assert all("contract_bad" in f.path for f in findings)
+
+    def test_real_wire_golden_matches_frame_constants(self):
+        """The committed TestGoldenBytes pin in tests/test_wire.py is
+        the witness the wire direction of the rule checks against."""
+        findings = analyze_paths([os.path.join(
+            REPO, "rlgpuschedule_tpu", "serve", "wire.py")])
+        assert [f for f in findings
+                if f.rule == "contract-drift"] == [], findings
+
+
+class TestCache:
+    """--cache DIR incremental mode: entries keyed on (file sha1,
+    rule-set hash); cross-file rules are never served from cache."""
+
+    def test_warm_hit_returns_identical_findings(self, tmp_path):
+        cache = FindingCache(str(tmp_path / "c"))
+        bad = os.path.join(FIXTURES, "bad_prng_reuse.py")
+        cold = analyze_file(bad, cache=cache)
+        assert cold and cache.misses >= 1 and cache.hits == 0
+        warm = analyze_file(bad, cache=cache)
+        assert warm == cold
+        assert cache.hits >= 1
+
+    def test_warm_second_run_is_faster(self, tmp_path):
+        cdir = str(tmp_path / "c")
+        pkg = os.path.join(REPO, "rlgpuschedule_tpu", "analysis")
+        t0 = time.monotonic()
+        cold = analyze_paths([pkg], cache_dir=cdir)
+        t_cold = time.monotonic() - t0
+        t0 = time.monotonic()
+        warm = analyze_paths([pkg], cache_dir=cdir)
+        t_warm = time.monotonic() - t0
+        assert warm == cold
+        assert t_warm < t_cold, (t_warm, t_cold)
+
+    def test_cli_cache_flag_round_trips(self, tmp_path):
+        bad = os.path.join(FIXTURES, "bad_host_sync.py")
+        cdir = tmp_path / "jc"
+        r1 = _cli(bad, "--no-baseline", "--cache", str(cdir))
+        r2 = _cli(bad, "--no-baseline", "--cache", str(cdir))
+        assert r1.returncode == r2.returncode == 1
+        assert r1.stdout == r2.stdout
+        assert any(cdir.iterdir())             # entries were written
+
+    def test_corrupt_cache_entry_degrades_to_miss(self, tmp_path):
+        cache = FindingCache(str(tmp_path / "c"))
+        bad = os.path.join(FIXTURES, "bad_impure.py")
+        cold = analyze_file(bad, cache=cache)
+        for p in (tmp_path / "c").iterdir():
+            p.write_text("not json")
+        again = analyze_file(bad, cache=cache)
+        assert again == cold
+
+    def test_cross_file_rule_findings_survive_a_warm_run(self, tmp_path):
+        """refusal-drift is cross-file: its verdict depends on other
+        files, so the warm run re-derives it instead of replaying."""
+        bad = os.path.join(FIXTURES, "refusal_bad", "train.py")
+        cdir = str(tmp_path / "c")
+        cold = analyze_paths([bad], cache_dir=cdir)
+        warm = analyze_paths([bad], cache_dir=cdir)
+        assert warm == cold
+        assert {f.rule for f in warm} == {"refusal-drift"}
+
+
 class TestSarif:
     def test_sarif_output_is_schema_shaped(self):
         fname, expected = BAD["blocking-under-lock"]
@@ -341,6 +449,11 @@ class TestSarif:
             assert loc["artifactLocation"]["uri"].endswith(".py")
             assert loc["region"]["startLine"] >= 1
             assert loc["region"]["startColumn"] >= 1
+            # PR-18: column regions so editors can underline; endColumn
+            # is exclusive, so it strictly exceeds startColumn
+            assert loc["region"]["endLine"] >= loc["region"]["startLine"]
+            assert loc["region"]["endColumn"] \
+                > loc["region"]["startColumn"]
 
     def test_sarif_clean_tree_has_empty_results(self, tmp_path):
         p = tmp_path / "clean.py"

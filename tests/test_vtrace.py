@@ -272,8 +272,9 @@ class TestVtraceAsync:
         losses = [h["total_loss"] for h in out["history"]]
         assert np.isfinite(rewards).all() and np.isfinite(losses).all()
         events = merge_dir(str(tmp_path))
-        assert not any(e["kind"] in ("recompile", "implicit_transfer")
-                       for e in events)
+        # implicit transfers RAISE under the no_implicit_transfers
+        # guard — they never appear as events, only recompiles do
+        assert not any(e["kind"] == "recompile" for e in events)
         end = next(e for e in events if e["kind"] == "run_end")
         assert end["async_staleness_max"] > 1
         assert end["async_importance_ratio_mean"] > 0

@@ -158,3 +158,42 @@ class TestRecvFrame:
         with pytest.raises(ConnectionError):
             wire.recv_frame(b)
         b.close()
+
+
+class TestGoldenBytes:
+    """The exact 24-byte frame prefix, pinned as a literal.
+
+    This is the protocol's change detector: if an edit to
+    ``serve/wire.py`` flips any of these bytes, old clients and new
+    servers are speaking different protocols — bump ``VERSION`` and
+    regenerate the pin deliberately. jsan's ``contract-drift`` rule
+    cross-validates this literal against the wire module's ``MAGIC``/
+    ``VERSION``/``struct`` constants (and fires on the wire module if
+    the pin is ever deleted), so the two can only change together.
+    """
+
+    # PREFIX.pack(MAGIC, VERSION, KIND_REQ, hlen=4, blen=10,
+    #             meta64=0x1122334455667788, meta32=0x99AABBCC)
+    GOLDEN_PREFIX = (b"RLSF"                              # magic
+                     b"\x01"                              # version
+                     b"\x01"                              # kind=REQ
+                     b"\x04\x00"                          # hlen=4 LE
+                     b"\x0a\x00\x00\x00"                  # blen=10 LE
+                     b"\x88\x77\x66\x55\x44\x33\x22\x11"  # meta64 LE
+                     b"\xcc\xbb\xaa\x99")                 # meta32 LE
+
+    def test_packed_prefix_matches_golden_bytes(self):
+        frame = wire.pack_frame(wire.KIND_REQ, b"hdr!", b"body-bytes",
+                                meta64=0x1122334455667788,
+                                meta32=0x99AABBCC)
+        assert len(self.GOLDEN_PREFIX) == wire.PREFIX_SIZE == 24
+        assert frame[:wire.PREFIX_SIZE] == self.GOLDEN_PREFIX
+        assert frame[wire.PREFIX_SIZE:] == b"hdr!" + b"body-bytes"
+
+    def test_golden_bytes_parse_back_exactly(self):
+        kind, hlen, blen, meta64, meta32 = wire.unpack_prefix(
+            self.GOLDEN_PREFIX)
+        assert kind == wire.KIND_REQ
+        assert (hlen, blen) == (4, 10)
+        assert meta64 == 0x1122334455667788
+        assert meta32 == 0x99AABBCC
