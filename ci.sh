@@ -515,6 +515,14 @@ assert s["post_warmup_recompiles"] == 0, s
 drift = s["p99_drift"]
 assert drift is None or drift < 3.0, f"p99 drift {drift}"
 assert s["shed_rate"] <= 0.5, s["shed_rate"]
+# RSS/heap-drift gate (ISSUE 19 satellite): a faulted soak must not
+# leak — eject/re-warm/readmit cycles and the retry hedge all recycle
+# buffers, so resident set growth over the run stays a few percent
+# (None on /proc-less hosts, where the gate degrades to a no-op)
+g = s["rss_growth_frac"]
+assert g is None or g < 0.15, (
+    f"RSS grew {g:.1%} over the chaos soak "
+    f"({s['rss_start_bytes']} -> {s['rss_end_bytes']} bytes)")
 # the fault lifecycle must be a readable story on the event bus
 kinds = {e["kind"] for e in merge_dir(sys.argv[2])}
 for k in ("serve_fault", "engine_eject", "engine_readmit",
@@ -537,6 +545,7 @@ print("chaos-soak smoke ok:", {
     "ejections": fs["ejections"],
     "readmissions": fs["readmissions"],
     "retry_hedges": fs["retry_hedges"],
+    "rss_growth": (None if g is None else round(g, 4)),
     "frontend": fe["post_drain_connect"]})
 EOF
 
@@ -595,6 +604,163 @@ assert pbt["shape"] == {"pop": 2, "data": 1, "model": 1}, pbt
 assert pbt["rule_table_hash"] == mesh["rule_table_hash"], (mesh, pbt)
 print("sharding smoke ok:", {"mesh": mesh["shape"], "pbt": pbt["shape"],
                              "rules": mesh["rule_table_hash"]})
+EOF
+
+echo "=== smoke: data flywheel (flight log -> continual retrain -> canary promotion, 2 CPU devices) ==="
+# ISSUE 19 acceptance, the closed loop end to end: (1) a routed soak
+# with the durable flight log attached seals crc-sidecar'd shards and
+# holds the conservation contract (rows_logged == served, exactly);
+# (2) train --continual ingests those shards through the V-trace
+# trust region (zero refusals on fresh same-policy traffic) and steps
+# the learner; (3) an intentionally-regressed candidate (seeded noise
+# that flips decisions on the logged states) must be BLOCKED by the
+# canary gate; (4) a clean candidate must promote with ZERO swap
+# recompiles, then the forced post-swap SLO fault must roll back and
+# restore the incumbent bit-identically — all three verdicts sealed in
+# the crc'd promotion ledger, every obs dir strict-alarms green (the
+# flywheel's event kinds are not alarm kinds).
+FLY_DIR=$(mktemp -d /tmp/ci_flywheel.XXXXXX)
+trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$VTRACE_OBS_DIR" \
+    "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_SOAK_OBS_DIR" \
+    "$CHAOS_JSON" "$SERVE_JSON" "$SOAK_JSON" "$CHAOS_SOAK_JSON" \
+    "$TRACE_JSON" \
+    "$MATRIX_OBS_DIR" "$MATRIX_CKPT_DIR" "$MATRIX_CLEAN_DIR" \
+    "$MATRIX_JSON" \
+    "$MESH_OBS_DIR" "$PBT_OBS_DIR" "$MESH_JSON" "$PBT_JSON" \
+    "$FLY_DIR"' EXIT
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python -m rlgpuschedule_tpu.serve --config ppo-mlp-synth64 \
+    --engines 2 --soak 5 --rate 120 --deadline-ms 250 \
+    --adaptive-wait --bucket 8 --pool-steps 2 \
+    --n-envs 2 --n-nodes 2 --gpus-per-node 4 --window-jobs 16 \
+    --queue-len 4 --horizon 64 \
+    --flight-log "$FLY_DIR/flog" --flight-capacity 32 --durable-log \
+    --obs-dir "$FLY_DIR/obs_soak" > "$FLY_DIR/soak.json"
+timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.obs.report "$FLY_DIR/obs_soak" \
+    --strict-alarms > /dev/null
+python - "$FLY_DIR" <<'EOF'
+import json, sys
+fly = sys.argv[1]
+rep = json.load(open(fly + "/soak.json"))
+s, fl = rep["soak"], rep["flight_log"]
+# the flywheel's conservation contract: every served row logged, shed
+# rows never logged — rows_logged == served EXACTLY
+assert fl["conservation_ok"], fl
+assert fl["rows_logged"] == s["served"] > 0, (fl, s["served"])
+assert s["post_warmup_recompiles"] == 0, s
+from rlgpuschedule_tpu.obs import merge_dir
+seals = [e for e in merge_dir(fly + "/obs_soak")
+         if e["kind"] == "flywheel_shard_seal"]
+assert seals and sum(e["rows"] for e in seals) == fl["rows_logged"], \
+    (len(seals), fl)
+prom = open(fly + "/obs_soak/metrics.prom").read()
+for name in ("flywheel_rows_logged_total", "flywheel_shards_sealed_total"):
+    assert name in prom, f"missing scrape series: {name}"
+# crc-verify every sealed shard through the reader itself
+from rlgpuschedule_tpu.flywheel import read_flight_log
+data = read_flight_log(fly + "/flog")
+assert not data.torn_tail and data.rows == fl["rows_logged"], \
+    (data.torn_tail, data.rows)
+print("flight-log smoke ok:", {"served": s["served"],
+                               "rows_logged": fl["rows_logged"],
+                               "shards": len(data.shards)})
+EOF
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.train --config ppo-mlp-synth64 \
+    --continual "$FLY_DIR/flog" --iterations 2 \
+    --n-envs 2 --n-nodes 2 --gpus-per-node 4 \
+    --window-jobs 16 --horizon 64 --queue-len 4 --n-steps 8 \
+    --n-epochs 1 --n-minibatches 2 \
+    --obs-dir "$FLY_DIR/obs_cont" --ckpt-dir "$FLY_DIR/ckpt" \
+    > "$FLY_DIR/cont.json"
+python - "$FLY_DIR" <<'EOF'
+import json, sys
+fly = sys.argv[1]
+s = json.load(open(fly + "/cont.json"))
+assert s["mode"] == "continual", s["mode"]
+# fresh same-policy traffic sits at rho ~ 1: the trust region must
+# admit every shard, and two V-trace iterations must step the learner
+assert s["shards_seen"] > 0 and s["shards_refused"] == 0, s
+assert s["shards_accepted"] == s["shards_seen"], s
+assert not s["torn_tail"], s
+assert s["rows_trained"] > 0 and s["final_step"] > 0, s
+assert 0.5 < s["rho_mean_trained"] < 2.0, s["rho_mean_trained"]
+prom = open(fly + "/obs_cont/metrics.prom").read()
+for name in ("flywheel_shard_staleness", "flywheel_rho_mean",
+             "flywheel_rho_max", "flywheel_shards_ingested_total",
+             "flywheel_shards_refused_total"):
+    assert name in prom, f"missing scrape series: {name}"
+print("continual smoke ok:", {
+    "shards": f"{s['shards_accepted']}/{s['shards_seen']}",
+    "rows_trained": s["rows_trained"],
+    "pseudo_steps": s["pseudo_steps"],
+    "final_step": s["final_step"],
+    "rho_mean": round(s["rho_mean_trained"], 4)})
+EOF
+# (3) the regressed arm: sigma 0.5 on the config seed flips the served
+# decision on the logged multi-legal-action states — the canary gate
+# must block it (the whole pipeline is seeded, so this is
+# deterministic, not a coin flip)
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.serve --config ppo-mlp-synth64 \
+    --bucket 8 --pool-steps 2 --n-envs 2 --n-nodes 2 \
+    --gpus-per-node 4 --window-jobs 16 --queue-len 4 --horizon 64 \
+    --flight-log "$FLY_DIR/flog" --durable-log --promote-noise 0.5 \
+    --obs-dir "$FLY_DIR/obs_block" > "$FLY_DIR/block.json"
+# (4) the clean arm: a numerically-indistinguishable candidate clears
+# the gate, promotes with zero swap recompiles, then the forced SLO
+# fault must roll it back and restore the incumbent bit-identically
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.serve --config ppo-mlp-synth64 \
+    --bucket 8 --pool-steps 2 --n-envs 2 --n-nodes 2 \
+    --gpus-per-node 4 --window-jobs 16 --queue-len 4 --horizon 64 \
+    --flight-log "$FLY_DIR/flog" --durable-log --promote-noise 1e-6 \
+    --promote-fault \
+    --obs-dir "$FLY_DIR/obs_prom" > "$FLY_DIR/promote.json"
+timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.obs.report "$FLY_DIR/obs_block" \
+    --strict-alarms > /dev/null
+timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python -m rlgpuschedule_tpu.obs.report "$FLY_DIR/obs_prom" \
+    --strict-alarms > /dev/null
+python - "$FLY_DIR" <<'EOF'
+import json, sys
+fly = sys.argv[1]
+blk = json.load(open(fly + "/block.json"))["promote"]
+assert blk["verdict"] == "blocked" and not blk["promoted"], blk
+assert blk["canary"]["max_regress_streak"] >= 2, blk["canary"]
+pro = json.load(open(fly + "/promote.json"))["promote"]
+assert pro["verdict"] == "promote" and pro["promoted"], pro
+assert pro["swap_recompiles"] == 0, pro
+assert pro["post_warmup_recompiles"] == 0, pro
+assert pro["rollback"], pro
+# the rollback restored the incumbent EXACTLY: the pre-promotion probe
+# decisions replay bit-identically after the swap back
+assert pro["probe_bit_identical"] is True, pro
+# promotion lineage: blocked + promote + rollback, all crc-sealed
+from rlgpuschedule_tpu.flywheel import read_ledger
+sealed, tail = read_ledger(fly + "/flog")
+assert [e["action"] for e in sealed] == \
+    ["blocked", "promote", "rollback"], [e["action"] for e in sealed]
+assert not tail, tail
+from rlgpuschedule_tpu.obs import merge_dir
+kinds_blk = {e["kind"] for e in merge_dir(fly + "/obs_block")}
+kinds_pro = {e["kind"] for e in merge_dir(fly + "/obs_prom")}
+assert "promote_blocked" in kinds_blk, sorted(kinds_blk)
+for k in ("promote_apply", "promote_rollback"):
+    assert k in kinds_pro, sorted(kinds_pro)
+prom = open(fly + "/obs_block/metrics.prom").read()
+for name in ("flywheel_canary_runs_total",
+             "flywheel_promotions_blocked_total"):
+    assert name in prom, f"missing scrape series: {name}"
+print("promotion smoke ok:", {
+    "blocked_agreement": round(blk["canary"]["candidate_agreement"], 3),
+    "promoted": pro["candidate"],
+    "rollback_reasons": pro["rollback_reasons"],
+    "probe_bit_identical": pro["probe_bit_identical"],
+    "ledger": [e["action"] for e in sealed]})
 EOF
 
 echo "=== tier-1 pytest gate 1/2: main pass (ROADMAP.md, minus spawn) ==="

@@ -172,6 +172,7 @@ MODE_FLAGS: dict[str, str] = {
     "vtrace": "--correction vtrace",
     "sync": "the synchronous loop (no --async)",
     "router": "--engines > 1 (multi-engine serving router)",
+    "continual": "--continual LOGDIR (flight-log retraining)",
 }
 
 # THE mode-combination refusal matrix — every pairwise refusal `train`
@@ -235,6 +236,24 @@ MODE_REFUSALS: tuple[tuple[str, str, str], ...] = (
      "device; a hierarchical (n_pods > 1) policy's router+placer heads "
      "have not been validated under per-engine replicated serving — "
      "serve hierarchical configs single-engine until they are"),
+    # continual mode (ISSUE 19 flywheel) replaces simulator rollouts
+    # with logged served traffic: the data source IS the mode, so every
+    # combination that reshapes the rollout/update loop is refused
+    ("continual", "pbt",
+     "continual ingest folds ONE flight log into one learner's "
+     "pseudo-trajectories; a population would train every member on "
+     "the same behavior stream (no per-member exploration signal)"),
+    ("continual", "async",
+     "the async engine overlaps simulator rollout collection with the "
+     "update; continual mode has no rollout to overlap — the flight "
+     "log is read once up front"),
+    ("continual", "hier",
+     "logged rows carry the flat policy's action heads; the "
+     "hierarchical joint log-prob has not been validated against "
+     "flight-log replay (same gap as vtrace x hier)"),
+    ("continual", "fused_chunk",
+     "run_fused scans the simulator train step; continual updates run "
+     "their own jitted learn step over a fixed ingested batch"),
 )
 
 

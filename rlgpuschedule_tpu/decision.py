@@ -75,3 +75,25 @@ def policy_decision(apply_fn: Callable, net_params: Any, obs: Any,
     ``serve.InferenceEngine`` (per request-batch dispatch)."""
     logits, _ = apply_fn(net_params, obs, mask)
     return greedy_actions(logits)
+
+
+def policy_decision_full(apply_fn: Callable, net_params: Any, obs: Any,
+                         mask: Any) -> tuple[Any, jax.Array, jax.Array]:
+    """:func:`policy_decision` plus the behavior record the data
+    flywheel logs: ``(actions, log_prob, value)``.
+
+    The actions are computed by the IDENTICAL masked-logits -> argmax
+    ops as :func:`policy_decision` (same apply, same argmax — the
+    eval↔serve bit-identity contract extends to the logged path);
+    ``log_prob`` is the joint greedy-action log-probability under the
+    behavior params (the denominator of every later V-trace importance
+    ratio — ``algos.vtrace.importance_ratios``), and ``value`` is the
+    behavior critic's estimate, which continual training bootstraps the
+    V-trace scan with (the same stored-behavior-value convention the
+    rollout buffer uses). Used by the serving engine's capture mode and
+    by the canary replay, so a served decision, its logged record, and
+    a candidate's replay all go through this one rule."""
+    from .algos import action_dist
+    logits, value = apply_fn(net_params, obs, mask)
+    actions = greedy_actions(logits)
+    return actions, action_dist.log_prob(logits, actions), value

@@ -1,0 +1,40 @@
+"""The data flywheel: serve → log → continually retrain → promote.
+
+The repo's first end-to-end self-improving path, built as three
+robustness problems (ISSUE 19):
+
+- :mod:`.flightlog` — crash-safe served-traffic trajectory log
+  (recycled shard buffers, crc32 sidecars, torn-tail-tolerant reads,
+  rows_logged == served conservation);
+- :mod:`.continual` — ``train --continual LOGDIR``: V-trace-corrected
+  off-policy retraining from logged shards, with measured staleness and
+  an importance-ratio trust region that refuses shards too off-policy
+  to learn from;
+- :mod:`.canary` — canary-gated promotion: shared-rule replay of a
+  held-out logged window, hysteresis regression gate, live
+  ``swap_params`` with blessed re-warm, post-swap SLO watchdog with
+  automatic rollback, and a crc-sidecar'd promotion ledger.
+
+Event kinds by emitter: ``flywheel_shard_seal`` (FlightLogWriter),
+``promote_blocked`` (canary gate), ``promote_apply`` (the serve CLI's
+promotion driver), ``promote_rollback`` (SLOWatchdog). None are alarm
+kinds — ``obs report --strict-alarms`` stays green across a healthy
+promotion.
+"""
+from .canary import (CanaryReport, LedgerCorruptError, PromotionLedger,
+                     SLOWatchdog, action_agreement, read_ledger,
+                     replay_decisions, run_canary)
+from .continual import (IngestReport, admit_shards, run_continual,
+                        shard_rho_stats, shards_to_transition)
+from .flightlog import (FlightLogCorruptError, FlightLogData,
+                        FlightLogError, FlightLogWriter, FlightShard,
+                        read_flight_log, unflatten_like)
+
+__all__ = [
+    "CanaryReport", "FlightLogCorruptError", "FlightLogData",
+    "FlightLogError", "FlightLogWriter", "FlightShard", "IngestReport",
+    "LedgerCorruptError", "PromotionLedger", "SLOWatchdog",
+    "action_agreement", "admit_shards", "read_flight_log", "read_ledger",
+    "replay_decisions", "run_canary", "run_continual", "shard_rho_stats",
+    "shards_to_transition", "unflatten_like",
+]

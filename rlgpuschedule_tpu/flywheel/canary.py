@@ -1,0 +1,367 @@
+"""Canary-gated promotion with automatic rollback: the flywheel's
+apply path.
+
+A candidate checkpoint never takes traffic on faith. First it replays a
+held-out logged window (:mod:`.flightlog`) next to the incumbent —
+both through :func:`..decision.policy_decision_full`, the ONE decision
+rule serving and evaluation already share, so the canary cannot drift
+from what the engines actually execute. The replay is compared row-wise
+against the **logged behavior actions** (ground truth of what was
+served): the incumbent's agreement is the reference (bit-identical
+when the incumbent IS the behavior snapshot), and a candidate whose
+per-slice agreement falls more than ``tol`` below the incumbent's votes
+"regress". Votes feed a signed-streak hysteresis gate (the
+AutoscaleAdvisor pattern): only ``hysteresis`` CONSECUTIVE regressing
+slices block, so one noisy slice cannot veto and one good slice cannot
+launder a trend. This is a behavior-drift gate — it bounds how far the
+candidate's served decisions move from measured traffic; outcome-based
+(reward-carrying) canarying is the documented open end.
+
+Promotion itself is :meth:`..serve.router.EngineRouter.swap_params`:
+shape-checked in-place weight swap + blessed re-warm through every
+warmed bucket (zero compiles expected — a compile would be a recompile
+alarm, which is the proof, not an accident). Afterward the
+:class:`SLOWatchdog` compares live p99/shed/recompile against EWMAs it
+learned from PRE-swap traffic; a breach streak (or a single post-swap
+recompile) triggers automatic rollback to the retained incumbent
+params. Every verdict — blocked, promoted, rolled back — lands in the
+:class:`PromotionLedger`, a crc-sidecar'd JSONL lineage that survives
+the same crash model as the flight log.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..checkpoint import _crc32_file
+from ..decision import (gate_stalled, policy_decision_full, preempt_slice,
+                        stall_threshold)
+
+LEDGER_NAME = "promotions.jsonl"
+
+
+class LedgerCorruptError(RuntimeError):
+    """The promotion ledger's sealed prefix fails its crc sidecar."""
+
+
+# ---- shared-rule replay ----------------------------------------------
+
+
+# one jitted replay program per (policy, stall-gate) pair — the
+# _GATHER_CACHE idiom, so repeated canary runs against the same
+# apply_fn reuse the compiled executable instead of re-tracing per call
+_REPLAY_PROGRAMS: "dict[tuple, Any]" = {}
+
+
+def _replay_program(apply_fn, thresh: int, gated: bool):
+    key = (apply_fn, thresh, gated)
+    fn = _REPLAY_PROGRAMS.get(key)
+    if fn is None:
+        def _replay(p, o, m, s, pre):
+            if gated:
+                m = gate_stalled(m, s, thresh, pre)
+            return policy_decision_full(apply_fn, p, o, m)
+        fn = _REPLAY_PROGRAMS[key] = jax.jit(_replay)
+    return fn
+
+
+def replay_decisions(apply_fn, params, obs: Any, mask: Any, stall,
+                     env_params=None):
+    """Replay a logged window (host pytrees, leading row axis) through
+    the SAME gated decision rule the serving engine compiles
+    (stall gate included) — ``(actions, log_prob, value)`` on host.
+
+    One full-window batch: the policy is batch-composition invariant
+    (pinned in tests/test_serve.py), so replaying [N] rows at once is
+    decision-equivalent to the engines' bucketed dispatches."""
+    pre = (preempt_slice(env_params) if env_params is not None else None)
+    thresh = stall_threshold(env_params) if pre is not None else 0
+
+    stall = np.zeros(int(np.asarray(jax.tree.leaves(mask)[0]).shape[0]),
+                     np.int32) if stall is None else np.asarray(stall,
+                                                                np.int32)
+    fn = _replay_program(apply_fn, int(thresh), pre is not None)
+    out = fn(params, obs, mask, stall, pre)
+    return jax.device_get(out)
+
+
+def action_agreement(a: Any, b: Any) -> np.ndarray:
+    """Row-wise agreement of two action pytrees: True where EVERY head
+    matches (bool[N])."""
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    agree = None
+    for x, y in zip(la, lb):
+        eq = np.asarray(x) == np.asarray(y)
+        eq = eq.reshape(eq.shape[0], -1).all(axis=1)
+        agree = eq if agree is None else (agree & eq)
+    return agree
+
+
+# ---- canary gate -----------------------------------------------------
+
+
+@dataclasses.dataclass
+class CanaryReport:
+    """One canary run's verdict and evidence."""
+    verdict: str                     # "promote" | "blocked"
+    rows: int
+    slices: int
+    incumbent_agreement: float       # vs logged behavior actions, overall
+    candidate_agreement: float
+    regress_slices: int
+    max_regress_streak: int
+    per_slice: "list[dict]"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def run_canary(apply_fn, incumbent_params, candidate_params, window,
+               example_obs: Any, example_mask: Any, env_params=None,
+               slices: int = 8, tol: float = 0.02, hysteresis: int = 2,
+               registry=None, bus=None) -> CanaryReport:
+    """Gate a candidate against the incumbent over a held-out logged
+    ``window`` (a :class:`.flightlog.FlightShard`, e.g. ``concat()``).
+    Blocks when ``hysteresis`` consecutive slices regress (candidate
+    agreement with the logged behavior actions more than ``tol`` below
+    the incumbent's on the same slice)."""
+    from .flightlog import unflatten_like
+    if slices < 1:
+        raise ValueError(f"slices must be >= 1, got {slices}")
+    if hysteresis < 1:
+        raise ValueError(f"hysteresis must be >= 1, got {hysteresis}")
+    obs = unflatten_like(example_obs, window.obs_leaves)
+    mask = unflatten_like(example_mask, window.mask_leaves)
+    logged = window.act_leaves
+    inc_act, _, _ = replay_decisions(apply_fn, incumbent_params, obs,
+                                     mask, window.stall, env_params)
+    cand_act, _, _ = replay_decisions(apply_fn, candidate_params, obs,
+                                      mask, window.stall, env_params)
+    inc_rows = action_agreement(inc_act, logged)
+    cand_rows = action_agreement(cand_act, logged)
+    n = int(inc_rows.shape[0])
+    bounds = np.linspace(0, n, min(slices, n) + 1, dtype=int)
+    per_slice: "list[dict]" = []
+    streak = best_streak = regress = 0
+    for k in range(len(bounds) - 1):
+        lo, hi = int(bounds[k]), int(bounds[k + 1])
+        if hi <= lo:
+            continue
+        ia = float(inc_rows[lo:hi].mean())
+        ca = float(cand_rows[lo:hi].mean())
+        bad = ca < ia - tol
+        streak = streak + 1 if bad else 0
+        best_streak = max(best_streak, streak)
+        regress += int(bad)
+        per_slice.append({"slice": k, "rows": hi - lo,
+                          "incumbent_agreement": ia,
+                          "candidate_agreement": ca, "regress": bad})
+    verdict = "blocked" if best_streak >= hysteresis else "promote"
+    report = CanaryReport(
+        verdict=verdict, rows=n, slices=len(per_slice),
+        incumbent_agreement=float(inc_rows.mean()),
+        candidate_agreement=float(cand_rows.mean()),
+        regress_slices=regress, max_regress_streak=best_streak,
+        per_slice=per_slice)
+    if registry is not None:
+        registry.counter(
+            "flywheel_canary_runs_total",
+            "canary replays executed against a candidate").inc()
+        if verdict == "blocked":
+            registry.counter(
+                "flywheel_promotions_blocked_total",
+                "candidate promotions blocked by the canary gate").inc()
+    if bus is not None and verdict == "blocked":
+        bus.emit("promote_blocked", rows=n,
+                 incumbent_agreement=report.incumbent_agreement,
+                 candidate_agreement=report.candidate_agreement,
+                 max_regress_streak=best_streak)
+    return report
+
+
+# ---- post-swap SLO watchdog ------------------------------------------
+
+
+class SLOWatchdog:
+    """Live-regression tripwire for a just-promoted candidate.
+
+    Pre-swap, :meth:`sample_baseline` folds the serving tier's own SLO
+    surface (the ``serve_decision_latency_p99_ms`` gauge the server's
+    ``slo_snapshot`` publishes) into an EWMA — the LEARNED baseline, so
+    the breach test compares the candidate to this deployment's actual
+    behavior, not a config constant. :meth:`arm` snapshots the shed and
+    recompile counters at swap time; each post-swap :meth:`observe`
+    tick then votes *breach* when p99 exceeds ``p99_factor ×`` the
+    learned baseline or NEW shedding appears, and ``breach_after``
+    consecutive breach votes request rollback. A post-swap recompile is
+    an immediate rollback — the swap contract says there must be none,
+    so one recompile means the fleet is not running the program that
+    was blessed."""
+
+    def __init__(self, registry, engine=None, p99_factor: float = 1.5,
+                 breach_after: int = 3, alpha: float = 0.2, bus=None):
+        from ..serve.batching import Ewma
+        if p99_factor <= 1.0:
+            raise ValueError(f"p99_factor must be > 1, got {p99_factor}")
+        if breach_after < 1:
+            raise ValueError(
+                f"breach_after must be >= 1, got {breach_after}")
+        self.registry = registry
+        self.engine = engine          # router/engine: recompile surface
+        self.p99_factor = float(p99_factor)
+        self.breach_after = int(breach_after)
+        self._bus = bus
+        self._g_p99 = registry.gauge("serve_decision_latency_p99_ms")
+        self._c_shed = registry.counter("serve_shed_total")
+        self._ewma = Ewma(alpha=alpha)
+        self._streak = 0
+        self._armed = False
+        self._shed0 = 0.0
+        self._shed_prev = 0.0
+        self._rec0 = 0
+
+    def _recompiles(self) -> int:
+        if self.engine is None:
+            return 0
+        return int(self.engine.post_warmup_recompiles)
+
+    @property
+    def baseline_p99_ms(self) -> "float | None":
+        return self._ewma.value
+
+    def sample_baseline(self) -> None:
+        """One pre-swap tick: learn the incumbent's p99 EWMA."""
+        p99 = float(self._g_p99.value)
+        if p99 > 0:
+            self._ewma.update(p99)
+
+    def arm(self) -> None:
+        """Snapshot shed/recompile counters at swap time; breach votes
+        only count deltas accrued AFTER this."""
+        self._shed0 = self._shed_prev = float(self._c_shed.value)
+        self._rec0 = self._recompiles()
+        self._streak = 0
+        self._armed = True
+
+    def observe(self) -> dict:
+        """One post-swap tick. Returns ``{rollback, reasons, streak,
+        p99_ms, baseline_p99_ms}`` — ``rollback=True`` means the caller
+        must swap the incumbent back NOW."""
+        if not self._armed:
+            raise RuntimeError("SLOWatchdog.observe() before arm()")
+        reasons = []
+        rec_delta = self._recompiles() - self._rec0
+        if rec_delta > 0:
+            reasons.append(f"recompile(+{rec_delta})")
+        p99 = float(self._g_p99.value)
+        base = self._ewma.value
+        p99_breach = (base is not None and p99 > 0
+                      and p99 > base * self.p99_factor)
+        if p99_breach:
+            reasons.append(f"p99({p99:.1f}ms > {self.p99_factor:g}x"
+                           f"{base:.1f}ms)")
+        shed = float(self._c_shed.value)
+        if shed > self._shed_prev:
+            reasons.append(f"shed(+{shed - self._shed_prev:g})")
+        self._shed_prev = shed
+        vote = bool(reasons)
+        self._streak = self._streak + 1 if vote else 0
+        rollback = rec_delta > 0 or self._streak >= self.breach_after
+        out = {"rollback": rollback, "reasons": reasons,
+               "streak": self._streak, "p99_ms": p99,
+               "baseline_p99_ms": base,
+               "shed_delta": shed - self._shed0,
+               "recompile_delta": rec_delta}
+        if rollback and self._bus is not None:
+            self._bus.emit("promote_rollback", reasons=reasons,
+                           streak=self._streak, p99_ms=p99,
+                           baseline_p99_ms=base)
+        return out
+
+
+# ---- promotion ledger ------------------------------------------------
+
+
+class PromotionLedger:
+    """Crash-safe JSONL lineage of every promotion decision.
+
+    Appends are flush (+fsync when ``durable``) then the crc sidecar
+    ``.crc/promotions.json`` — ``{"bytes": N, "crc32": C}`` over the
+    sealed prefix — is rewritten atomically. A crash between the two
+    leaves entries PAST the sealed prefix: :func:`read_ledger` returns
+    them separately as the unsealed tail (parseable lines are not data
+    loss, they are just not yet covered by the integrity contract), and
+    a prefix that fails its crc raises :class:`LedgerCorruptError`."""
+
+    def __init__(self, directory: str, durable: bool = True):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        os.makedirs(os.path.join(self.directory, ".crc"), exist_ok=True)
+        self.path = os.path.join(self.directory, LEDGER_NAME)
+        self.durable = bool(durable)
+        self._lock = threading.Lock()
+
+    @property
+    def _sidecar(self) -> str:
+        return os.path.join(self.directory, ".crc", "promotions.json")
+
+    def append(self, record: dict) -> None:
+        """Append one decision record (a json-able dict; an ``event``
+        key naming the decision — canary/promote/rollback/blocked — is
+        the convention the CLI and tests read back)."""
+        line = json.dumps(record, sort_keys=True) + "\n"
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line)
+                f.flush()
+                if self.durable:
+                    os.fsync(f.fileno())
+            crc = _crc32_file(self.path)
+            size = os.path.getsize(self.path)
+            tmp = f"{self._sidecar}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"bytes": size, "crc32": crc}, f)
+                f.flush()
+                if self.durable:
+                    os.fsync(f.fileno())
+            os.replace(tmp, self._sidecar)
+
+
+def read_ledger(directory: str) -> "tuple[list[dict], list[dict]]":
+    """Load a promotion ledger: ``(sealed, tail)`` — sealed entries are
+    crc-verified against the sidecar; tail entries (appended after the
+    last sidecar update, e.g. a crash mid-append) parse but are flagged
+    by position. Missing ledger = ``([], [])``."""
+    directory = os.path.abspath(directory)
+    path = os.path.join(directory, LEDGER_NAME)
+    side = os.path.join(directory, ".crc", "promotions.json")
+    if not os.path.exists(path):
+        return [], []
+    with open(path, "rb") as f:
+        blob = f.read()
+    sealed_bytes = 0
+    if os.path.exists(side):
+        with open(side) as f:
+            meta = json.load(f)
+        sealed_bytes = int(meta["bytes"])
+        import zlib
+        if zlib.crc32(blob[:sealed_bytes]) != int(meta["crc32"]):
+            raise LedgerCorruptError(
+                f"{path}: sealed prefix ({sealed_bytes} bytes) fails its "
+                f"crc sidecar — the lineage cannot be trusted")
+    parse = lambda chunk: [json.loads(l) for l in
+                           chunk.decode().splitlines() if l.strip()]
+    sealed = parse(blob[:sealed_bytes])
+    tail = []
+    for l in blob[sealed_bytes:].decode(errors="replace").splitlines():
+        try:
+            tail.append(json.loads(l))
+        except json.JSONDecodeError:
+            pass                     # torn final line: flagged by count
+    return sealed, tail

@@ -1,0 +1,245 @@
+"""Continual training from served traffic: the flywheel's learn path.
+
+``train --continual LOGDIR`` lands here: verified flight-log shards
+(:mod:`.flightlog`) become off-policy pseudo-trajectories and feed the
+SAME fused PPO learn step the simulator path uses
+(:func:`..algos.ppo.make_learn_step`) with ``correction="vtrace"``
+forced — logged traffic is *measurably* behavior-lagged (the learner
+has stepped since the serving snapshot), which is precisely the
+actor-learner staleness V-trace (PR 12) exists to correct. The lag is
+measured, not assumed (the Podracer contract):
+
+- **staleness** — ``learner_step - shard.policy_step`` per shard, on
+  the ``flywheel_shard_staleness`` gauge;
+- **importance ratios** — one batched apply under the learner's current
+  params gives target log-probs against the shard's STORED behavior
+  log-probs (never recomputed post-hoc — the Transition contract);
+  ``flywheel_rho_mean``/``flywheel_rho_max`` gauges publish the stats;
+- **trust region** — a shard whose mean ratio leaves
+  ``[1/trust, trust]`` or whose max ratio exceeds ``rho_max_cap`` is
+  REFUSED (``flywheel_shards_refused_total``): off-policy enough that
+  V-trace's clipped correction would be all clip and no signal, so the
+  honest move is to drop it loudly rather than train on noise.
+
+Ingest shape: served rows arrive in dispatch order and carry no
+successor observation, so rows fold into ``[T, E]`` pseudo-trajectories
+(row ``t*E + e`` → step ``t``, lane ``e``), ``done`` stays False, the
+reward is the row's SLO outcome (+1 served within deadline or
+deadline-free, −1 served late — the serving tier's own objective), and
+the V-trace scan bootstraps from the stored behavior values with the
+final row batch's value as the tail — documented approximations, pinned
+by tests, not silent ones.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..algos import action_dist
+from ..algos.ppo import make_learn_step
+from ..algos.rollout import Transition
+from ..decision import greedy_actions
+from .flightlog import (FlightLogData, FlightLogError, FlightShard,
+                        read_flight_log, unflatten_like)
+
+
+@dataclasses.dataclass
+class IngestReport:
+    """What one ingest pass accepted/refused (per-shard admission)."""
+    shards_seen: int
+    shards_accepted: int
+    shards_refused: int
+    rows_accepted: int
+    torn_tail: bool
+    per_shard: "list[dict]"
+
+
+def shard_rho_stats(apply_fn, params, shard: FlightShard,
+                    example_obs: Any, example_mask: Any,
+                    example_act: Any) -> "tuple[float, float]":
+    """(mean, max) unclipped importance ratios of ``shard`` under the
+    learner's current ``params`` — one batched apply, target log-prob
+    against the shard's stored behavior log-prob."""
+    obs = unflatten_like(example_obs, shard.obs_leaves)
+    mask = unflatten_like(example_mask, shard.mask_leaves)
+    act = unflatten_like(example_act, shard.act_leaves)
+    logits, _ = apply_fn(params, obs, mask)
+    target_lp = action_dist.log_prob(logits, act)
+    rho = np.exp(np.asarray(target_lp, np.float64)
+                 - np.asarray(shard.log_prob, np.float64))
+    return float(rho.mean()), float(rho.max())
+
+
+def admit_shards(data: FlightLogData, apply_fn, params, learner_step: int,
+                 example_obs: Any, example_mask: Any, example_act: Any,
+                 trust: float = 2.0, rho_max_cap: float = 8.0,
+                 registry=None) -> "tuple[list[FlightShard], IngestReport]":
+    """Trust-region admission over every verified shard. Returns the
+    accepted shards (seq order) and the per-shard report; publishes the
+    staleness/ρ gauges and the refusal counter when a registry rides
+    along."""
+    if trust < 1.0:
+        raise ValueError(f"trust must be >= 1.0, got {trust}")
+    g_stale = g_mean = g_max = c_refused = c_ingested = None
+    if registry is not None:
+        g_stale = registry.gauge(
+            "flywheel_shard_staleness",
+            "learner_step - policy_step of the last shard considered "
+            "for ingest (behavior lag, in train steps)")
+        g_mean = registry.gauge(
+            "flywheel_rho_mean",
+            "mean unclipped V-trace importance ratio of the last shard "
+            "considered for ingest")
+        g_max = registry.gauge(
+            "flywheel_rho_max",
+            "max unclipped V-trace importance ratio of the last shard "
+            "considered for ingest")
+        c_refused = registry.counter(
+            "flywheel_shards_refused_total",
+            "shards refused by the ingest trust region (ρ-stats outside "
+            "[1/trust, trust] / rho_max_cap)")
+        c_ingested = registry.counter(
+            "flywheel_shards_ingested_total",
+            "shards accepted by the ingest trust region")
+    accepted: "list[FlightShard]" = []
+    per_shard: "list[dict]" = []
+    for s in data.shards:
+        stale = int(learner_step) - s.policy_step
+        rho_mean, rho_max = shard_rho_stats(
+            apply_fn, params, s, example_obs, example_mask, example_act)
+        ok = (1.0 / trust <= rho_mean <= trust
+              and rho_max <= rho_max_cap)
+        if g_stale is not None:
+            g_stale.set(stale)
+            g_mean.set(rho_mean)
+            g_max.set(rho_max)
+            (c_ingested if ok else c_refused).inc()
+        per_shard.append({"seq": s.seq, "rows": s.rows,
+                          "staleness": stale, "rho_mean": rho_mean,
+                          "rho_max": rho_max, "accepted": ok})
+        if ok:
+            accepted.append(s)
+    report = IngestReport(
+        shards_seen=len(data.shards), shards_accepted=len(accepted),
+        shards_refused=len(data.shards) - len(accepted),
+        rows_accepted=sum(s.rows for s in accepted),
+        torn_tail=data.torn_tail, per_shard=per_shard)
+    return accepted, report
+
+
+def _fold_rows(leaves: "list[np.ndarray]", T: int, E: int):
+    return [l[:T * E].reshape(T, E, *l.shape[1:]) for l in leaves]
+
+
+def shards_to_transition(shards: "list[FlightShard]", n_envs: int,
+                         tile: int, example_obs: Any,
+                         example_mask: Any, example_act: Any,
+                         ) -> "tuple[Transition, jax.Array, int]":
+    """Fold accepted shards' rows into one ``[T, E]`` Transition (row
+    ``t*E + e`` → step t, lane e; the tail remainder that cannot fill a
+    step — and any steps past the largest ``T`` whose flattened batch
+    tiles ``tile`` (the update geometry's minibatch size or count) — is
+    dropped, counted by the caller via ``rows - T*E``). Returns
+    ``(transition, last_value[E], T)``."""
+    if not shards:
+        raise FlightLogError("no shards survived the ingest trust region")
+    E = int(n_envs)
+    cat = lambda ls: [np.concatenate(x) for x in zip(*ls)]
+    obs_l = cat([s.obs_leaves for s in shards])
+    mask_l = cat([s.mask_leaves for s in shards])
+    act_l = cat([s.act_leaves for s in shards])
+    lp = np.concatenate([s.log_prob for s in shards])
+    value = np.concatenate([s.value for s in shards])
+    outcome = np.concatenate([s.outcome for s in shards])
+    rows = int(lp.shape[0])
+    T = rows // E
+    while T >= 2 and (T * E) % tile:
+        T -= 1
+    if T < 2:
+        raise FlightLogError(
+            f"{rows} ingested rows cannot form >= 2 pseudo-steps of "
+            f"{E} lanes with a flattened batch tiling {tile}; log more "
+            f"traffic or shrink n_envs / the minibatch geometry")
+    tr = Transition(
+        obs=unflatten_like(example_obs, _fold_rows(obs_l, T, E)),
+        action=unflatten_like(example_act, _fold_rows(act_l, T, E)),
+        log_prob=lp[:T * E].reshape(T, E),
+        value=value[:T * E].reshape(T, E),
+        reward=np.where(outcome[:T * E] == 2, -1.0, 1.0
+                        ).astype(np.float32).reshape(T, E),
+        done=np.zeros((T, E), bool),
+        mask=unflatten_like(example_mask, _fold_rows(mask_l, T, E)),
+        env_steps_dt=np.zeros((T, E), np.float32))
+    # no successor observation exists for the final served rows, so the
+    # scan bootstraps from the last row batch's stored behavior value
+    last_value = value[(T - 1) * E:T * E].astype(np.float32)
+    return tr, last_value, T
+
+
+def run_continual(exp, logdir: str, iterations: int = 1, *,
+                  trust: float = 2.0, rho_max_cap: float = 8.0,
+                  registry=None, ckpt=None) -> dict:
+    """The continual-training loop: verify + admit the flight log once,
+    then run ``iterations`` V-trace-corrected learn steps over the
+    folded pseudo-trajectories. ``exp`` is a built
+    :class:`..experiment.Experiment` (params possibly checkpoint-
+    restored); its train_state advances in place and is saved through
+    ``ckpt`` (a :class:`..checkpoint.Checkpointer`) when given. Returns
+    the summary the CLI prints."""
+    data = read_flight_log(logdir)
+    if not data.shards:
+        raise FlightLogError(
+            f"no verified shards under {logdir}"
+            + (f" (torn tail: {data.torn_reason})" if data.torn_tail
+               else ""))
+    ex_obs = jax.tree.map(lambda x: np.asarray(x[:1]), exp.carry.obs)
+    ex_mask = jax.tree.map(lambda x: np.asarray(x[:1]), exp.carry.mask)
+    logits, _ = exp.apply_fn(exp.train_state.params, ex_obs, ex_mask)
+    ex_act = jax.tree.map(np.asarray, greedy_actions(logits))
+    learner_step = int(exp.train_state.step)
+    accepted, report = admit_shards(
+        data, exp.apply_fn, exp.train_state.params, learner_step,
+        ex_obs, ex_mask, ex_act, trust=trust, rho_max_cap=rho_max_cap,
+        registry=registry)
+    algo = dataclasses.replace(exp.cfg.ppo, correction="vtrace")
+    tile = (algo.minibatch_size if algo.minibatch_size is not None
+            else algo.n_minibatches)
+    tr, last_value, T = shards_to_transition(
+        accepted, exp.cfg.n_envs, tile, ex_obs, ex_mask, ex_act)
+    # the learn step's flatten reads n_steps from the config — bind it
+    # to the folded T (data decides the geometry here, not the config)
+    algo = dataclasses.replace(algo, n_steps=T)
+    learn = jax.jit(make_learn_step(exp.apply_fn, algo))
+    metrics = None
+    for _ in range(int(iterations)):
+        exp.key, key = jax.random.split(exp.key)
+        exp.train_state, metrics = learn(exp.train_state, tr,
+                                         last_value, key)
+        if ckpt is not None:
+            ckpt.save(int(exp.train_state.step), exp.train_state)
+    rows_trained = T * exp.cfg.n_envs
+    summary = {
+        "mode": "continual",
+        "logdir": logdir,
+        "iterations": int(iterations),
+        "rows_logged": data.rows,
+        "rows_accepted": report.rows_accepted,
+        "rows_trained": rows_trained,
+        "rows_dropped_fold": report.rows_accepted - rows_trained,
+        "shards_seen": report.shards_seen,
+        "shards_accepted": report.shards_accepted,
+        "shards_refused": report.shards_refused,
+        "torn_tail": report.torn_tail,
+        "per_shard": report.per_shard,
+        "pseudo_steps": T,
+        "final_step": int(exp.train_state.step),
+    }
+    if metrics is not None:
+        m = jax.device_get(metrics)
+        summary["rho_mean_trained"] = float(np.asarray(m.rho_mean))
+        summary["rho_max_trained"] = float(np.asarray(m.rho_max))
+        summary["total_loss"] = float(np.asarray(m.total_loss))
+    return summary

@@ -1,0 +1,374 @@
+"""Crash-safe served-traffic flight log: the flywheel's write path.
+
+The serving data plane (PR 17's arena) already holds every decision's
+inputs and outputs in preallocated host slabs for the lifetime of one
+dispatch; this module gives those rows somewhere durable to go. The
+:class:`FlightLogWriter` owns ONE recycled shard buffer (arena-style:
+allocated once from the first batch's shapes, reused for every shard —
+the hot path is memcpy into a slab, never an allocation); when the
+buffer fills it is **sealed**: written to a temp file, atomically
+renamed to ``shard-NNNNNN.npz``, and only then described by a crc32
+sidecar under ``.crc/`` (the Checkpointer's sidecar pattern,
+:mod:`..checkpoint`). The payload-then-sidecar ordering is the torn-tail
+contract: a crash can leave at most a trailing shard without a valid
+sidecar, and :func:`read_flight_log` drops exactly that tail (flagged,
+counted) while a bad crc ANYWHERE EARLIER is corruption and raises.
+
+Row schema (fixed per log; enumerated pytree leaves):
+
+==============  =======================================================
+column          meaning
+==============  =======================================================
+``obs<i>``      observation leaves, one row per served request
+``mask<i>``     action-mask leaves
+``act<i>``      the served greedy action leaves (what the client got)
+``log_prob``    joint behavior log-prob of the served action (f32) —
+                straight out of the engine's compiled decision program
+                (:func:`..decision.policy_decision_full`), never
+                recomputed post-hoc
+``value``       the behavior critic's estimate (f32) — continual
+                training bootstraps its V-trace scan with it
+``stall``       the client's consecutive-zero-dt count (i32)
+``outcome``     deadline outcome (i8): 0 = no deadline, 1 = met,
+                2 = served late (resolved past its SLO but not shed)
+``policy_step`` scalar i64: the behavior policy's train step (staleness
+                numerator for the ingest trust region)
+==============  =======================================================
+
+Conservation: shed requests never reach a dispatch, so the writer's
+``rows_logged`` equals the server's ``served`` count EXACTLY — the same
+structural submitted == served + shed contract the serving tier pins
+(tests assert ``rows_logged == served``, crc-verified on reload).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import threading
+from typing import Any
+
+import numpy as np
+
+from ..checkpoint import _crc32_file
+
+_SHARD_RE = re.compile(r"^shard-(\d{6})\.npz$")
+
+
+def shard_name(seq: int) -> str:
+    return f"shard-{seq:06d}.npz"
+
+
+def _sidecar_path(directory: str, seq: int) -> str:
+    return os.path.join(directory, ".crc", f"shard-{seq:06d}.json")
+
+
+class FlightLogError(RuntimeError):
+    """Base: the flight log on disk cannot be used as asked."""
+
+
+class FlightLogCorruptError(FlightLogError):
+    """A NON-tail shard failed its crc/sidecar check: interior
+    corruption, not a torn tail — refusing to silently drop data."""
+
+
+def _leaves(tree: Any) -> "list[np.ndarray]":
+    import jax
+    return [np.asarray(l) for l in jax.tree.leaves(tree)]
+
+
+class FlightLogWriter:
+    """Appends served rows into one recycled buffer; seals full (or
+    final partial) buffers to crc-sidecar'd shards.
+
+    Thread-safe: dispatcher pumps append concurrently under one lock
+    (the copy is slab-to-slab memcpy, same cost class as the arena's own
+    row writes). ``durable=True`` fsyncs each sealed payload and sidecar
+    before the atomic rename publishes it, so a sealed shard survives
+    process kill AND power loss; the default rides the page cache (a
+    process crash still loses nothing — the rename is the publish)."""
+
+    def __init__(self, directory: str, capacity: int = 4096,
+                 policy_step: int = 0, registry=None, bus=None,
+                 durable: bool = False):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        os.makedirs(os.path.join(self.directory, ".crc"), exist_ok=True)
+        self.capacity = int(capacity)
+        self.policy_step = int(policy_step)
+        self.durable = bool(durable)
+        self._bus = bus
+        self._lock = threading.Lock()
+        self._obs: "list[np.ndarray] | None" = None
+        self._mask: "list[np.ndarray] | None" = None
+        self._act: "list[np.ndarray] | None" = None
+        self._lp = np.zeros(capacity, np.float32)
+        self._value = np.zeros(capacity, np.float32)
+        self._stall = np.zeros(capacity, np.int32)
+        self._outcome = np.zeros(capacity, np.int8)
+        self._n = 0
+        self._seq = 0
+        self._seq_rows = 0       # rows already sealed to disk
+        self._closed = False
+        if registry is not None:
+            self._c_rows = registry.counter(
+                "flywheel_rows_logged_total",
+                "served decision rows appended to the flight log "
+                "(conservation: must equal the server's served count)")
+            self._c_shards = registry.counter(
+                "flywheel_shards_sealed_total",
+                "flight-log shards sealed to disk with crc sidecars")
+        else:
+            self._c_rows = self._c_shards = None
+
+    # ---- introspection ----------------------------------------------
+
+    @property
+    def rows_logged(self) -> int:
+        """Total rows accepted (sealed + still buffered)."""
+        with self._lock:
+            return self._seq_rows + self._n
+
+    @property
+    def shards_sealed(self) -> int:
+        with self._lock:
+            return self._seq
+
+    # ---- append ------------------------------------------------------
+
+    def _alloc(self, obs_l, mask_l, act_l) -> None:
+        cap = self.capacity
+        mk = lambda ls: [np.zeros((cap,) + l.shape[1:], l.dtype)
+                         for l in ls]
+        self._obs, self._mask, self._act = mk(obs_l), mk(mask_l), mk(act_l)
+
+    def append_batch(self, obs: Any, mask: Any, actions: Any,
+                     log_prob, value, stall, outcome) -> None:
+        """Append one dispatch's rows (leading axis = rows; pytrees for
+        ``obs``/``mask``/``actions``). Copies into the recycled buffer;
+        seals as many full shards as the batch fills."""
+        obs_l, mask_l, act_l = _leaves(obs), _leaves(mask), _leaves(actions)
+        lp = np.asarray(log_prob, np.float32)
+        val = np.asarray(value, np.float32)
+        st = np.asarray(stall, np.int32)
+        oc = np.asarray(outcome, np.int8)
+        n = int(lp.shape[0])
+        with self._lock:
+            if self._closed:
+                raise FlightLogError("FlightLogWriter is closed")
+            if self._obs is None:
+                self._alloc(obs_l, mask_l, act_l)
+            off = 0
+            while off < n:
+                m = min(self.capacity - self._n, n - off)
+                s, e = self._n, self._n + m
+                for dst, src in zip(self._obs, obs_l):
+                    dst[s:e] = src[off:off + m]
+                for dst, src in zip(self._mask, mask_l):
+                    dst[s:e] = src[off:off + m]
+                for dst, src in zip(self._act, act_l):
+                    dst[s:e] = src[off:off + m]
+                self._lp[s:e] = lp[off:off + m]
+                self._value[s:e] = val[off:off + m]
+                self._stall[s:e] = st[off:off + m]
+                self._outcome[s:e] = oc[off:off + m]
+                self._n += m
+                off += m
+                if self._n == self.capacity:
+                    self._seal_locked()
+            if self._c_rows is not None:
+                self._c_rows.inc(n)
+
+    # ---- seal --------------------------------------------------------
+
+    def _seal_locked(self) -> None:
+        n, seq = self._n, self._seq
+        if n == 0:
+            return
+        cols: "dict[str, np.ndarray]" = {}
+        for i, l in enumerate(self._obs):
+            cols[f"obs{i}"] = l[:n]
+        for i, l in enumerate(self._mask):
+            cols[f"mask{i}"] = l[:n]
+        for i, l in enumerate(self._act):
+            cols[f"act{i}"] = l[:n]
+        cols["log_prob"] = self._lp[:n]
+        cols["value"] = self._value[:n]
+        cols["stall"] = self._stall[:n]
+        cols["outcome"] = self._outcome[:n]
+        cols["policy_step"] = np.int64(self.policy_step)
+        path = os.path.join(self.directory, shard_name(seq))
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **cols)
+            f.flush()
+            if self.durable:
+                os.fsync(f.fileno())
+        crc = _crc32_file(tmp)
+        # publish payload FIRST, sidecar second: a crash between the two
+        # leaves a sidecar-less tail shard, which the reader treats as
+        # torn (dropped + flagged) — never a sidecar naming a missing or
+        # half-written payload
+        os.replace(tmp, path)
+        side = _sidecar_path(self.directory, seq)
+        stmp = f"{side}.tmp.{os.getpid()}"
+        with open(stmp, "w") as f:
+            json.dump({"file": shard_name(seq), "crc32": crc, "rows": n,
+                       "policy_step": self.policy_step}, f)
+            f.flush()
+            if self.durable:
+                os.fsync(f.fileno())
+        os.replace(stmp, side)
+        self._seq = seq + 1
+        self._seq_rows += n
+        self._n = 0
+        if self._c_shards is not None:
+            self._c_shards.inc()
+        if self._bus is not None:
+            # "shard", not "seq": seq is one of the bus's own reserved
+            # stamp fields and emit() refuses payload keys that shadow it
+            self._bus.emit("flywheel_shard_seal", shard=seq, rows=n,
+                           policy_step=self.policy_step)
+
+    def seal(self) -> None:
+        """Seal the buffered partial shard now (no-op when empty)."""
+        with self._lock:
+            self._seal_locked()
+
+    def close(self) -> None:
+        """Seal the tail and refuse further appends (idempotent)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._seal_locked()
+            self._closed = True
+
+    def __enter__(self) -> "FlightLogWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---- read path -------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FlightShard:
+    """One verified shard, columns as host arrays (leaves enumerated in
+    the writer's order — :func:`unflatten_like` rebuilds pytrees)."""
+    seq: int
+    path: str
+    rows: int
+    policy_step: int
+    obs_leaves: "list[np.ndarray]"
+    mask_leaves: "list[np.ndarray]"
+    act_leaves: "list[np.ndarray]"
+    log_prob: np.ndarray
+    value: np.ndarray
+    stall: np.ndarray
+    outcome: np.ndarray
+
+
+@dataclasses.dataclass
+class FlightLogData:
+    """A verified flight log: every shard crc-checked, torn tail (at
+    most one trailing shard without a valid sidecar) dropped + flagged."""
+    shards: "list[FlightShard]"
+    torn_tail: bool = False
+    torn_reason: str = ""
+
+    @property
+    def rows(self) -> int:
+        return sum(s.rows for s in self.shards)
+
+    def concat(self) -> "FlightShard":
+        """All shards as one pseudo-shard (columns concatenated in seq
+        order; ``policy_step`` of the OLDEST shard — the conservative
+        staleness bound)."""
+        if not self.shards:
+            raise FlightLogError("empty flight log (no verified shards)")
+        cat = lambda ls: [np.concatenate(x) for x in zip(*ls)]
+        return FlightShard(
+            seq=-1, path="<concat>", rows=self.rows,
+            policy_step=min(s.policy_step for s in self.shards),
+            obs_leaves=cat([s.obs_leaves for s in self.shards]),
+            mask_leaves=cat([s.mask_leaves for s in self.shards]),
+            act_leaves=cat([s.act_leaves for s in self.shards]),
+            log_prob=np.concatenate([s.log_prob for s in self.shards]),
+            value=np.concatenate([s.value for s in self.shards]),
+            stall=np.concatenate([s.stall for s in self.shards]),
+            outcome=np.concatenate([s.outcome for s in self.shards]))
+
+
+def unflatten_like(example: Any, leaves: "list[np.ndarray]") -> Any:
+    """Rebuild a logged pytree column from an example with the same
+    structure (the env/net the caller already holds — the log stores
+    leaves, not treedefs)."""
+    import jax
+    return jax.tree.unflatten(jax.tree.structure(example), leaves)
+
+
+def _load_shard(directory: str, seq: int, path: str) -> FlightShard:
+    side = _sidecar_path(directory, seq)
+    with open(side) as f:
+        meta = json.load(f)
+    actual = _crc32_file(path)
+    if actual != int(meta["crc32"]):
+        raise FlightLogCorruptError(
+            f"{os.path.basename(path)}: crc32 mismatch (sidecar "
+            f"{int(meta['crc32']):#010x}, on disk {actual:#010x})")
+    with np.load(path) as z:
+        grab = lambda pre: [z[k] for k in sorted(
+            (k for k in z.files if re.fullmatch(pre + r"\d+", k)),
+            key=lambda k: int(k[len(pre):]))]
+        shard = FlightShard(
+            seq=seq, path=path, rows=int(meta["rows"]),
+            policy_step=int(meta["policy_step"]),
+            obs_leaves=grab("obs"), mask_leaves=grab("mask"),
+            act_leaves=grab("act"), log_prob=z["log_prob"],
+            value=z["value"], stall=z["stall"], outcome=z["outcome"])
+    if shard.rows != int(shard.log_prob.shape[0]):
+        raise FlightLogCorruptError(
+            f"{os.path.basename(path)}: sidecar says {shard.rows} rows, "
+            f"payload has {int(shard.log_prob.shape[0])}")
+    return shard
+
+
+def read_flight_log(directory: str) -> FlightLogData:
+    """Load and verify every shard under ``directory`` in sequence
+    order. Sidecar-less/corrupt LAST shard = torn tail (dropped,
+    flagged); any earlier failure raises
+    :class:`FlightLogCorruptError`. ``.tmp.`` leftovers are ignored
+    (they are, by construction, unpublished torn writes)."""
+    directory = os.path.abspath(directory)
+    found = []
+    for name in os.listdir(directory):
+        m = _SHARD_RE.match(name)
+        if m:
+            found.append((int(m.group(1)), os.path.join(directory, name)))
+    found.sort()
+    shards: "list[FlightShard]" = []
+    torn, reason = False, ""
+    for i, (seq, path) in enumerate(found):
+        try:
+            shards.append(_load_shard(directory, seq, path))
+        except Exception as e:
+            # missing sidecar / truncated zip / crc mismatch: on the
+            # LAST shard any of these is the at-most-one torn tail the
+            # payload-then-sidecar ordering guarantees; anywhere earlier
+            # it is interior corruption and must not be papered over
+            if i == len(found) - 1:
+                torn = True
+                reason = f"{os.path.basename(path)}: {type(e).__name__}"
+                break
+            if isinstance(e, FlightLogCorruptError):
+                raise
+            raise FlightLogCorruptError(
+                f"non-tail shard {os.path.basename(path)} is unreadable "
+                f"({type(e).__name__}: {e}); interior corruption, not a "
+                f"torn tail") from e
+    return FlightLogData(shards=shards, torn_tail=torn, torn_reason=reason)

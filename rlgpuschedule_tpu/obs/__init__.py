@@ -50,6 +50,11 @@ Event kinds by emitter:
    ``gang_shrink``, ``supervisor_done``
 == multihost worker: ``worker_start``, ``worker_resumed``,
    ``worker_step``, ``worker_done``, ``clock_skew``
+== data flywheel (``flywheel/``): ``flywheel_shard_seal`` (flight-log
+   writer), ``promote_blocked`` (canary gate), ``promote_apply`` (serve
+   CLI promotion driver), ``promote_rollback`` (SLO watchdog) — none
+   are alarm kinds, so a healthy promotion keeps ``--strict-alarms``
+   green
 """
 from .events import (EventBus, SCHEMA_VERSION, event_streams, merge_dir,
                      merge_events, read_events)

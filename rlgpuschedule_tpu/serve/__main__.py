@@ -23,6 +23,12 @@ Five modes, composable in one invocation:
   with the numpy batch-constructor count gated to ZERO in the arena
   arm; ``--wire-requests N`` adds the socket arms (HTTP
   connection-per-request vs framed keep-alive).
+- ``--flight-log DIR`` / ``--promote``: the data flywheel (ISSUE 19) —
+  record served decisions into crc-sidecar'd shards during ``--soak``
+  (exactly-once: ``rows_logged == served``), then canary-gate a
+  candidate checkpoint against the logged window and promote it live
+  (``swap_params`` + blessed re-warm) under an SLO watchdog that rolls
+  back automatically; the whole lineage lands in the promotion ledger.
 
 ``--engines N`` serves every mode through the mesh-resolved
 :class:`~.router.EngineRouter` (one engine per data-axis device,
@@ -185,6 +191,55 @@ def build_parser() -> argparse.ArgumentParser:
                         "nested spans on the event bus; requires "
                         "--obs-dir (spans ride the JSONL stream). NOT "
                         "--trace, which picks the workload trace source")
+    # data flywheel (ISSUE 19): flight log + canary-gated promotion
+    p.add_argument("--flight-log", default=None, metavar="DIR",
+                   help="with --soak: record every served decision "
+                        "(obs/mask/action/behavior log-prob/value/"
+                        "stall/deadline outcome) into crc-sidecar'd "
+                        "shards under DIR; with --promote*: the logged "
+                        "window the canary replays. Recording switches "
+                        "the engine to capture mode (same compiled "
+                        "program, extra outputs — zero-recompile "
+                        "contract intact)")
+    p.add_argument("--flight-capacity", type=int, default=512,
+                   help="flight log rows per sealed shard")
+    p.add_argument("--durable-log", action="store_true",
+                   help="fsync flight-log shards + promotion-ledger "
+                        "lines on seal (power-loss durability; default "
+                        "is flush-only — see obs.events for the "
+                        "overhead stance)")
+    p.add_argument("--promote", default=None, metavar="CKPTDIR",
+                   help="canary-gated promotion: load the candidate "
+                        "policy from this checkpoint dir, replay the "
+                        "--flight-log window under candidate vs "
+                        "incumbent through the shared decision rule, "
+                        "and only swap the serving weights if the "
+                        "hysteresis gate clears; post-swap SLO "
+                        "watchdog rolls back automatically")
+    p.add_argument("--promote-step", type=int, default=None,
+                   help="candidate checkpoint step (default: latest)")
+    p.add_argument("--promote-noise", type=float, default=None,
+                   metavar="SIGMA",
+                   help="synthesize the candidate by perturbing the "
+                        "incumbent with seeded N(0, SIGMA) noise "
+                        "(alone: the candidate IS the perturbed "
+                        "incumbent; with --promote: noise on top of "
+                        "the loaded candidate). Large SIGMA is the "
+                        "ci.sh seeded-regressed candidate the gate "
+                        "must block")
+    p.add_argument("--promote-fault", action="store_true",
+                   help="inject a post-swap SLO regression (the "
+                        "watchdog's observed p99 is inflated 10x) to "
+                        "prove automatic rollback restores the "
+                        "incumbent bit-identically")
+    p.add_argument("--canary-slices", type=int, default=8,
+                   help="held-out window slices the hysteresis gate "
+                        "scores")
+    p.add_argument("--canary-tol", type=float, default=0.02,
+                   help="per-slice agreement regression tolerance")
+    p.add_argument("--canary-hysteresis", type=int, default=2,
+                   help="consecutive regressed slices that block "
+                        "promotion")
     return p
 
 
@@ -193,10 +248,14 @@ def main(argv: "list[str] | None" = None) -> dict:
     from ..configs import CONFIGS, repro_tuple
     if args.config not in CONFIGS:
         sys.exit(f"unknown config {args.config!r}")
+    promote_mode = (args.promote is not None
+                    or args.promote_noise is not None)
     if (not args.bench and args.fleet is None and args.soak is None
-            and not args.scaleout and not args.host_path):
+            and not args.scaleout and not args.host_path
+            and not promote_mode):
         sys.exit("nothing to do: pass --bench, --soak S, --scaleout, "
-                 "--host-path, and/or --fleet N")
+                 "--host-path, --promote/--promote-noise, and/or "
+                 "--fleet N")
     if args.fleet is not None and args.fleet <= 0:
         sys.exit("--fleet must be a positive cluster count")
     if args.bucket <= 0 or (args.bucket & (args.bucket - 1)):
@@ -289,6 +348,36 @@ def main(argv: "list[str] | None" = None) -> dict:
     if args.trace_spans and not args.obs_dir:
         sys.exit("--trace-spans records spans on the event bus; pass "
                  "--obs-dir with it (refusing the silent no-op)")
+    if args.flight_log is not None and args.soak is None \
+            and not promote_mode:
+        sys.exit("--flight-log records --soak traffic or feeds "
+                 "--promote replay; pass one of them (refusing the "
+                 "silent no-op)")
+    if promote_mode and args.flight_log is None:
+        sys.exit("promotion replays a logged window; pass "
+                 "--flight-log DIR with --promote/--promote-noise")
+    if args.flight_capacity <= 0:
+        sys.exit("--flight-capacity must be a positive row count")
+    if args.promote_step is not None and args.promote is None:
+        sys.exit("--promote-step picks the --promote candidate step; "
+                 "pass --promote CKPTDIR with it (refusing the silent "
+                 "no-op)")
+    if args.promote_noise is not None and args.promote_noise <= 0:
+        sys.exit("--promote-noise must be a positive sigma")
+    if args.promote_fault and not promote_mode:
+        sys.exit("--promote-fault injects a post-swap SLO regression; "
+                 "pass --promote/--promote-noise with it (refusing "
+                 "the silent no-op)")
+    if args.canary_slices < 1:
+        sys.exit("--canary-slices must be >= 1")
+    if args.canary_tol < 0:
+        sys.exit("--canary-tol must be >= 0")
+    if args.canary_hysteresis < 1:
+        sys.exit("--canary-hysteresis must be >= 1")
+    if args.durable_log and args.flight_log is None:
+        sys.exit("--durable-log hardens the --flight-log shards and "
+                 "ledger; pass --flight-log DIR with it (refusing the "
+                 "silent no-op)")
     if args.fleet_regime is not None:
         from ..sim.faults import FAULT_REGIMES
         if args.fleet_regime not in FAULT_REGIMES:
@@ -361,6 +450,10 @@ def main(argv: "list[str] | None" = None) -> dict:
         if chaos_specs is not None:
             from .router import ServeFaultInjector
             injector = ServeFaultInjector(chaos_specs, bus=bus)
+        # flight-log recording and canary replay both need the engine's
+        # capture outputs (behavior log-prob/value from the SAME
+        # compiled decision program — never a post-hoc recompute)
+        capture = args.flight_log is not None
         if args.engines > 1:
             from ..parallel.mesh import serve_devices
             avail = len(serve_devices())
@@ -372,7 +465,8 @@ def main(argv: "list[str] | None" = None) -> dict:
                                   exp.env_params, max_bucket=args.bucket,
                                   registry=registry, bus=bus,
                                   tracer=tracer, n_engines=args.engines,
-                                  fault_injector=injector)
+                                  fault_injector=injector,
+                                  capture=capture)
             print(f"engine router: {args.engines} engines on "
                   f"{[str(e.device) for e in engine.engines]}"
                   + (" (CPU: dispatch serialized)"
@@ -384,15 +478,24 @@ def main(argv: "list[str] | None" = None) -> dict:
                                      exp.env_params,
                                      max_bucket=args.bucket,
                                      registry=registry, bus=bus,
-                                     tracer=tracer)
+                                     tracer=tracer, capture=capture)
         pool = None
         if (args.bench or args.soak is not None or args.scaleout
-                or args.host_path):
+                or args.host_path or promote_mode):
             pool = build_request_pool(exp.apply_fn,
                                       exp.train_state.params,
                                       exp.env_params, exp.traces,
                                       steps=args.pool_steps,
                                       faults=exp.faults)
+        flight_writer = None
+        if args.flight_log is not None and args.soak is not None:
+            from ..flywheel import FlightLogWriter
+            flight_writer = FlightLogWriter(
+                os.path.abspath(args.flight_log),
+                capacity=args.flight_capacity,
+                policy_step=int(exp.train_state.step),
+                registry=registry, bus=bus,
+                durable=args.durable_log)
         deadline_s = (args.deadline_ms / 1e3
                       if args.deadline_ms is not None else None)
         if args.bench:
@@ -417,7 +520,8 @@ def main(argv: "list[str] | None" = None) -> dict:
             engine.warmup(obs0, mask0)   # every bucket pre-paid
             server = PolicyServer(engine, registry=registry,
                                   tracer=tracer,
-                                  adaptive_wait=args.adaptive_wait)
+                                  adaptive_wait=args.adaptive_wait,
+                                  flight_log=flight_writer)
             advisor = None
             if args.autoscale:
                 advisor = AutoscaleAdvisor(registry,
@@ -465,6 +569,21 @@ def main(argv: "list[str] | None" = None) -> dict:
             soak["post_warmup_recompiles"] = \
                 engine.post_warmup_recompiles
             report["soak"] = soak
+            if flight_writer is not None:
+                flight_writer.close()   # seal the tail shard
+                # exactly-once accounting: every dispatched row was
+                # logged, every shed row was not (shed requests never
+                # reach the engine, so they never reach the log)
+                fl = {"dir": os.path.abspath(args.flight_log),
+                      "rows_logged": flight_writer.rows_logged,
+                      "served": soak["served"],
+                      "conservation_ok":
+                          flight_writer.rows_logged == soak["served"]}
+                report["flight_log"] = fl
+                print(f"flight log: {fl['rows_logged']} rows sealed "
+                      f"under {fl['dir']}, conservation "
+                      + ("ok" if fl["conservation_ok"] else "VIOLATED"),
+                      file=sys.stderr)
             drift = soak["p99_drift"]
             print(f"soak: {soak['requests']} requests over "
                   f"{soak['duration_s']:.1f}s at {soak['rate_hz']:.0f}/s"
@@ -489,6 +608,10 @@ def main(argv: "list[str] | None" = None) -> dict:
                       f"{fs['retry_hedges']}, conservation "
                       + ("ok" if conserved else "VIOLATED"),
                       file=sys.stderr)
+        if promote_mode:
+            report["promote"] = _run_promotion(
+                args, cfg, exp, engine, pool, registry, bus,
+                warmed=args.soak is not None)
         if args.scaleout:
             report["scaleout"] = run_scaleout(
                 exp.apply_fn, exp.train_state.params, exp.env_params,
@@ -570,6 +693,169 @@ def main(argv: "list[str] | None" = None) -> dict:
             bus.close()
     print(json.dumps(report))
     return report
+
+
+def _swap_weights(engine, params) -> "tuple[int, ...]":
+    """Live swap + blessed re-warm through whichever serving surface is
+    up: the router swaps every engine under its device lock; a single
+    engine swaps in place. Both re-drive the warmed buckets so a shape/
+    dtype drift surfaces HERE as a recompile alarm, not on live traffic."""
+    if hasattr(engine, "swap_params"):
+        return engine.swap_params(params)
+    engine.set_params(params)
+    return engine.rewarm()
+
+
+def _run_promotion(args, cfg, exp, engine, pool, registry, bus,
+                   warmed: bool) -> dict:
+    """``serve --promote``: canary-gate the candidate on the logged
+    window, swap only if the gate clears, then watch the post-swap SLOs
+    and roll back automatically on regression.
+
+    The candidate comes from ``--promote CKPTDIR`` (a real checkpoint,
+    e.g. the continual retrain's output) and/or ``--promote-noise``
+    (seeded perturbation — the ci.sh regressed-candidate arm).
+    ``--promote-fault`` inflates the watchdog's observed p99 10x after
+    the swap: an injected SLO regression exercising the rollback path
+    end-to-end (the rollback itself is real — weights swap back and the
+    probe must match the pre-promotion decisions bit-identically)."""
+    import os
+    import time
+
+    import jax
+    import numpy as np
+
+    from ..flywheel import (PromotionLedger, SLOWatchdog, read_flight_log,
+                            run_canary, unflatten_like)
+
+    flight_dir = os.path.abspath(args.flight_log)
+    data = read_flight_log(flight_dir)
+    if not data.shards:
+        sys.exit(f"--promote: no verified flight-log shards under "
+                 f"{flight_dir}"
+                 + (f" (torn tail: {data.torn_reason})"
+                    if data.torn_tail else ""))
+    window = data.concat()
+    obs0, mask0 = pool[0]
+    incumbent = exp.train_state.params
+
+    candidate = incumbent
+    source = "incumbent"
+    if args.promote is not None:
+        from ..checkpoint import Checkpointer
+        with Checkpointer(os.path.abspath(args.promote)) as cckpt:
+            cand_state, _, _, _ = cckpt.restore(
+                exp.train_state, step=args.promote_step)
+            source = (f"{os.path.abspath(args.promote)}"
+                      f"@{cckpt.last_restored_step}")
+        candidate = cand_state.params
+    if args.promote_noise is not None:
+        rng = np.random.default_rng(cfg.seed)
+        candidate = jax.tree.map(
+            lambda l: (np.asarray(l) + rng.normal(
+                0.0, args.promote_noise, np.shape(l)
+            ).astype(np.asarray(l).dtype))
+            if np.issubdtype(np.asarray(l).dtype, np.floating) else l,
+            candidate)
+        source += f"+noise(sigma={args.promote_noise:g},seed={cfg.seed})"
+
+    rep = run_canary(exp.apply_fn, incumbent, candidate, window,
+                     obs0, mask0, env_params=exp.env_params,
+                     slices=args.canary_slices, tol=args.canary_tol,
+                     hysteresis=args.canary_hysteresis,
+                     registry=registry, bus=bus)
+    ledger = PromotionLedger(flight_dir, durable=args.durable_log)
+    lineage = {"candidate": source,
+               "incumbent_step": int(exp.train_state.step),
+               "window_rows": window.rows,
+               "verdict": rep.verdict,
+               "incumbent_agreement": rep.incumbent_agreement,
+               "candidate_agreement": rep.candidate_agreement}
+    out = {"candidate": source, "verdict": rep.verdict,
+           "canary": rep.to_json(), "promoted": False,
+           "rollback": False, "ledger_entries": 1}
+    if rep.verdict != "promote":
+        ledger.append(dict(lineage, action="blocked",
+                           regress_streak=rep.max_regress_streak))
+        print(f"promotion BLOCKED: candidate agreement "
+              f"{rep.candidate_agreement:.3f} vs incumbent "
+              f"{rep.incumbent_agreement:.3f} on the logged window "
+              f"(regressed streak {rep.max_regress_streak} >= "
+              f"{args.canary_hysteresis})", file=sys.stderr)
+        return out
+
+    # gate cleared: pre-promotion probe -> swap -> watchdog
+    if not warmed:
+        engine.warmup(obs0, mask0)
+    k = min(args.bucket, window.rows)
+    probe_obs = unflatten_like(obs0, [l[:k] for l in window.obs_leaves])
+    probe_mask = unflatten_like(mask0,
+                                [l[:k] for l in window.mask_leaves])
+    probe_stall = window.stall[:k]
+
+    def probe() -> "tuple[list, float]":
+        t0 = time.perf_counter()
+        dec, _ = engine.decide(probe_obs, probe_mask, probe_stall)
+        # capture triple: [0] is the action tree (promote mode always
+        # serves a capture engine — --flight-log is required)
+        acts = [np.asarray(a) for a in jax.tree.leaves(
+            jax.device_get(dec[0]))]
+        return acts, (time.perf_counter() - t0) * 1e3
+
+    g_p99 = registry.gauge("serve_decision_latency_p99_ms")
+    wd = SLOWatchdog(registry, engine=engine, breach_after=2, bus=bus)
+    pre_acts: list = []
+    for _ in range(4):
+        pre_acts, ms = probe()
+        g_p99.set(ms)
+        wd.sample_baseline()
+    recomp_before = int(engine.post_warmup_recompiles)
+    driven = _swap_weights(engine, candidate)
+    wd.arm()
+    swap_recompiles = int(engine.post_warmup_recompiles) - recomp_before
+    if bus is not None:
+        bus.emit("promote_apply", candidate=source,
+                 rewarmed_buckets=list(driven),
+                 swap_recompiles=swap_recompiles)
+    ledger.append(dict(lineage, action="promote",
+                       rewarmed_buckets=list(driven),
+                       swap_recompiles=swap_recompiles))
+    out.update(promoted=True, rewarmed_buckets=list(driven),
+               swap_recompiles=swap_recompiles, ledger_entries=2)
+    print(f"promoted {source}: canary agreement "
+          f"{rep.candidate_agreement:.3f}, re-warmed buckets "
+          f"{tuple(driven)}, swap recompiles {swap_recompiles}",
+          file=sys.stderr)
+
+    ticks, breach = [], None
+    for _ in range(max(3, args.canary_hysteresis + 1)):
+        _, ms = probe()
+        if args.promote_fault:
+            ms *= 10.0        # injected post-swap SLO regression
+        g_p99.set(ms)
+        tick = wd.observe()
+        ticks.append({k_: tick[k_] for k_ in
+                      ("rollback", "reasons", "streak", "p99_ms",
+                       "baseline_p99_ms")})
+        if tick["rollback"]:
+            breach = tick
+            break
+    out["watchdog_ticks"] = ticks
+    if breach is not None:
+        _swap_weights(engine, incumbent)
+        post_acts, _ = probe()
+        bit = (len(pre_acts) == len(post_acts)
+               and all(np.array_equal(a, b)
+                       for a, b in zip(pre_acts, post_acts)))
+        ledger.append(dict(lineage, action="rollback",
+                           reasons=breach["reasons"],
+                           bit_identical=bool(bit)))
+        out.update(rollback=True, rollback_reasons=breach["reasons"],
+                   probe_bit_identical=bool(bit), ledger_entries=3)
+        print(f"ROLLBACK: {breach['reasons']}; incumbent restored, "
+              f"probe decisions bit-identical: {bit}", file=sys.stderr)
+    out["post_warmup_recompiles"] = int(engine.post_warmup_recompiles)
+    return out
 
 
 def _frontend_selfcheck(handle, obs0, mask0) -> dict:

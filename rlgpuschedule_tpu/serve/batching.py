@@ -504,9 +504,28 @@ class PolicyServer:
                  tracer=None, sample_seed: int = 0,
                  adaptive_wait: bool = False, data_plane: str = "arena",
                  example_obs: Any = None, example_mask: Any = None,
-                 arena_blocks: "int | None" = None):
+                 arena_blocks: "int | None" = None, flight_log=None):
         from ..obs import Registry
         self.engine = engine
+        # data-flywheel tap: a capture-mode engine returns
+        # (actions, behavior log-prob, value) per dispatch; the server
+        # unpacks the triple and, when a flight log is attached, appends
+        # every SERVED row (shed rows never dispatch, so rows_logged ==
+        # served is structural, not best-effort)
+        self._capture = bool(getattr(engine, "capture", False))
+        self._flight_log = flight_log
+        if flight_log is not None and not self._capture:
+            raise ValueError(
+                "flight_log requires a capture-mode engine "
+                "(capture=True): the log's behavior log-prob and value "
+                "columns come out of the engine's compiled decision "
+                "program, never a post-hoc recompute")
+        # outcome scratch: one dispatch's deadline outcomes, reused
+        # every batch (the arena discipline — the flight log copies the
+        # rows out before the next dispatch can overwrite the slice)
+        self._outcome_scratch = (
+            np.zeros(int(engine.max_bucket), np.int8)
+            if flight_log is not None else None)
         self.registry = registry if registry is not None else Registry()
         self.tracer = tracer if tracer is not None else NULL_TRACER
         if max_wait_s is not None and max_wait_s < 0:
@@ -1015,6 +1034,35 @@ class PolicyServer:
                 finally:
                     self._sleepers -= 1
 
+    def _split_capture(self, out):
+        """Unpack one engine dispatch output: a capture engine returns
+        the ``(actions, behavior log-prob, value)`` triple, a plain
+        engine just actions (then log-prob/value are ``None``)."""
+        if self._capture:
+            actions, blp, bval = out
+            return actions, blp, bval
+        return out, None, None
+
+    def _log_rows(self, obs, mask, stall, actions, blp, bval, n: int,
+                  lats: "list[float]", deads) -> None:
+        """Append this dispatch's ``n`` SERVED rows to the flight log.
+        Deadline outcome per row: 0 = no deadline, 1 = met, 2 = served
+        late (resolved past its SLO but not shed). Shed rows never reach
+        a dispatch, so the log's row count equals ``serve_dispatches``'
+        served total exactly — the flywheel's conservation contract."""
+        import jax
+        outcome = self._outcome_scratch[:n]
+        outcome[:] = 0
+        for i, d in enumerate(deads):
+            if d is not None:
+                outcome[i] = 1 if lats[i] <= d else 2
+        self._flight_log.append_batch(
+            jax.tree.map(lambda l: np.asarray(l)[:n], obs),
+            jax.tree.map(lambda l: np.asarray(l)[:n], mask),
+            jax.tree.map(lambda l: np.asarray(l)[:n], actions),
+            np.asarray(blp)[:n], np.asarray(bval)[:n],
+            np.asarray(stall)[:n], outcome)
+
     def _pump_legacy(self, max_wait_s: "float | None") -> int:
         with self._lock:
             self._shed_expired(self._clock())
@@ -1038,16 +1086,24 @@ class PolicyServer:
                     obs = stack_requests([r.obs for r in batch])
                     mask = stack_requests([r.mask for r in batch])
                     stall = np.asarray([r.stall for r in batch], np.int32)
-                actions, bucket = self.engine.decide(obs, mask, stall)
+                out, bucket = self.engine.decide(obs, mask, stall)
+                actions, blp, bval = self._split_capture(out)
                 now = self._clock()
                 with self.tracer.span("scatter"):
                     per_req = scatter_results(actions, n)
+            lats = [now - r.t_submit for r in batch]
+            if self._flight_log is not None:
+                # inside the try: the dispatcher loop's no-silent-drop
+                # invariant is that a raising pump has already resolved
+                # its batch's futures — a failing flight-log append must
+                # fail the batch loudly, never strand it
+                self._log_rows(obs, mask, stall, actions, blp, bval, n,
+                               lats, [r.deadline_s for r in batch])
         except BaseException as e:
             for r in batch:
                 if not r.future.cancelled():
                     r.future.set_exception(e)
             raise
-        lats = [now - r.t_submit for r in batch]
         self._account_dispatch(
             now, t_disp, n, bucket, lats,
             t_first=min(r.t_submit for r in batch))
@@ -1062,7 +1118,7 @@ class PolicyServer:
         compact live rows over dead ones (shed slots become padding),
         and neutralize the pad tail IN PLACE (zero obs, all-legal bool
         masks, zero stall) — pure slice assignment, no allocation.
-        Returns ``(n_live, bucket, futures, t_submits)``."""
+        Returns ``(n_live, bucket, futures, t_submits, deadlines)``."""
         spin_deadline = time.monotonic() + 5.0
         while not all(blk.published[:blk.claimed]):
             if time.monotonic() > spin_deadline:
@@ -1078,7 +1134,7 @@ class PolicyServer:
         live = [i for i in range(blk.claimed) if not blk.dead[i]]
         n_live = len(live)
         if n_live == 0:
-            return 0, 0, [], []
+            return 0, 0, [], [], []
         if n_live != blk.claimed:
             # compact: shift live rows down over dead ones (dst <= src,
             # so in-place row moves are safe); rare — shed path only
@@ -1092,6 +1148,7 @@ class PolicyServer:
                 blk.stall[dst] = blk.stall[src]
                 blk.futures[dst] = blk.futures[src]
                 blk.t_submit[dst] = blk.t_submit[src]
+                blk.deadline[dst] = blk.deadline[src]
         bucket = next_bucket(n_live, self.engine.max_bucket)
         if n_live < bucket:
             for leaf in blk.obs:
@@ -1101,7 +1158,7 @@ class PolicyServer:
                                        else 0)
             blk.stall[n_live:bucket] = 0
         return (n_live, bucket, blk.futures[:n_live],
-                blk.t_submit[:n_live])
+                blk.t_submit[:n_live], blk.deadline[:n_live])
 
     def _arena_views(self, blk: _ArenaBlock, bucket: int):
         """Contiguous ``[:bucket]`` views of the slab, re-assembled into
@@ -1158,7 +1215,7 @@ class PolicyServer:
             return 0
         t_disp = self._clock()
         try:
-            n_live, bucket, futs, t_subs = self._seal_block(blk)
+            n_live, bucket, futs, t_subs, deads = self._seal_block(blk)
         except BaseException:
             ring.recycle(blk)
             raise
@@ -1168,24 +1225,36 @@ class PolicyServer:
         try:
             if self.tracer is NULL_TRACER:   # span-free hot path
                 obs, mask, stall = self._arena_views(blk, bucket)
-                actions, bucket = self.engine.decide(obs, mask, stall)
+                out, bucket = self.engine.decide(obs, mask, stall)
+                actions, blp, bval = self._split_capture(out)
                 now = self._clock()
                 per_req = self._scatter_arena(blk, actions, n_live)
             else:
                 with self.tracer.span("serve_batch", n=n_live):
                     with self.tracer.span("arena_seal"):
                         obs, mask, stall = self._arena_views(blk, bucket)
-                    actions, bucket = self.engine.decide(obs, mask, stall)
+                    out, bucket = self.engine.decide(obs, mask, stall)
+                    actions, blp, bval = self._split_capture(out)
                     now = self._clock()
                     with self.tracer.span("scatter"):
                         per_req = self._scatter_arena(blk, actions, n_live)
+            lats = [now - t for t in t_subs]
+            if self._flight_log is not None:
+                # tap point: the slab views stay valid until ring.recycle
+                # below (donation consumed the DEVICE copies, not these
+                # host slabs), and the flight log copies rows into its
+                # own recycled shard buffer before returning. Inside the
+                # try: a failing append must resolve this batch's
+                # futures with the exception (the dispatcher loop's
+                # no-silent-drop invariant), never strand them
+                self._log_rows(obs, mask, stall, actions, blp, bval,
+                               n_live, lats, deads)
         except BaseException as e:
             for fut in futs:
                 if not fut.cancelled():
                     fut.set_exception(e)
             ring.recycle(blk)
             raise
-        lats = [now - t for t in t_subs]
         self._account_dispatch(now, t_disp, n_live, bucket, lats,
                                t_first=min(t_subs))
         for fut, a, lat in zip(futs, per_req, lats):

@@ -25,6 +25,7 @@ accounting, not CPU wall-clock scaling.
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Any
 
@@ -625,6 +626,19 @@ def fit_paced_gaps(fit, n: int, seed, rate_hz: float) -> np.ndarray:
     return gaps * ((1.0 / rate_hz) / mean)
 
 
+def _rss_bytes() -> "int | None":
+    """Resident-set size from ``/proc/self/statm`` (no psutil dep);
+    None where procfs is absent (non-Linux). Used by the chaos soak's
+    heap-drift gate: a steady-state serving plane recycling arena slabs
+    must not grow its RSS materially under sustained load + faults."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return None
+
+
 def run_chaos_soak(server, pool: "list[tuple[Any, Any]]", *, fit,
                    duration_s: float = 6.0, rate_hz: float = 150.0,
                    deadline_s: "float | None" = None, router=None,
@@ -649,6 +663,7 @@ def run_chaos_soak(server, pool: "list[tuple[Any, Any]]", *, fit,
     n_gaps = max(int(duration_s * rate_hz * 2) + 16, 1)
     gaps = fit_paced_gaps(fit, n_gaps, seed=(seed, 0xC7A05),
                           rate_hz=rate_hz)
+    rss_start = _rss_bytes()
     futures = []
     cursor = 0
     t_start = time.perf_counter()
@@ -707,6 +722,16 @@ def run_chaos_soak(server, pool: "list[tuple[Any, Any]]", *, fit,
         "p99_drift": (p99_b / p99_a
                       if p99_a and p99_b and p99_a > 0 else None),
     }
+    # heap-drift gate inputs: RSS before the first submit vs after the
+    # last future resolved (all recycled slabs back in the ring)
+    rss_end = _rss_bytes()
+    out["rss_start_bytes"] = rss_start
+    out["rss_end_bytes"] = rss_end
+    out["rss_growth_bytes"] = (rss_end - rss_start
+                               if rss_start is not None
+                               and rss_end is not None else None)
+    out["rss_growth_frac"] = ((rss_end - rss_start) / rss_start
+                              if rss_start else None)
     if router is not None:
         out["fault_stats"] = router.fault_stats()
         out["per_engine_rows"] = [s.rows for s in router.stats()]

@@ -27,7 +27,8 @@ import numpy as np
 
 from ..analysis.sentinels import (CompileCounter, RecompileSentinelError,
                                   no_implicit_transfers)
-from ..decision import (gate_stalled, policy_decision, preempt_slice,
+from ..decision import (gate_stalled, policy_decision,
+                        policy_decision_full, preempt_slice,
                         stall_threshold)
 from ..obs.trace import NULL_TRACER
 from .batching import next_bucket, pad_batch
@@ -55,7 +56,8 @@ class InferenceEngine:
     def __init__(self, apply_fn, net_params: Any, env_params: Any = None,
                  max_bucket: int = 256, registry=None, bus=None,
                  strict: bool = False, stall_gate: bool = True,
-                 tracer=None, device=None, engine_id: "int | None" = None):
+                 tracer=None, device=None, engine_id: "int | None" = None,
+                 capture: bool = False):
         from ..obs import Registry
         if max_bucket <= 0 or (max_bucket & (max_bucket - 1)):
             raise ValueError(f"max_bucket must be a positive power of "
@@ -83,6 +85,7 @@ class InferenceEngine:
         thresh = stall_threshold(env_params) if pre is not None else 0
         self._has_stall_gate = pre is not None
         self._warmed: set[int] = set()
+        self._example: "tuple[Any, Any] | None" = None
         # engine_id labels the sentinel series so N routed engines keep
         # N separate counters in ONE registry (the per-engine
         # zero-recompile contract is per engine, not fleet-aggregate)
@@ -95,6 +98,14 @@ class InferenceEngine:
         self._compiles = self.registry.counter(
             "serve_bucket_compiles_total",
             "blessed per-bucket warmup compiles", labels=labels)
+        # capture mode (the data-flywheel tap): the SAME single compiled
+        # program additionally returns the behavior log-prob and value
+        # per row (decision.policy_decision_full) — part of the program
+        # from the start, so the zero-recompile contract is untouched,
+        # and the actions come from the identical masked-argmax ops, so
+        # served actions stay bit-identical to the uncaptured engine
+        self.capture = bool(capture)
+        rule = policy_decision_full if capture else policy_decision
         # ONE jit per engine, built here and reused every dispatch (the
         # jsan recompile-hazard discipline); request buffers are donated
         # — they are per-dispatch transients, and donation lets XLA
@@ -108,13 +119,12 @@ class InferenceEngine:
             # The donation win lives in the big obs/mask request
             # buffers anyway.
             def _decide(params, obs, mask, stall):
-                return policy_decision(
-                    apply_fn, params, obs,
-                    gate_stalled(mask, stall, thresh, pre))
+                return rule(apply_fn, params, obs,
+                            gate_stalled(mask, stall, thresh, pre))
             self._step = jax.jit(_decide, donate_argnums=(1, 2))
         else:
             def _decide(params, obs, mask):
-                return policy_decision(apply_fn, params, obs, mask)
+                return rule(apply_fn, params, obs, mask)
             self._step = jax.jit(_decide, donate_argnums=(1, 2))
 
     @property
@@ -127,6 +137,59 @@ class InferenceEngine:
 
     def bucket_for(self, n: int) -> int:
         return next_bucket(n, self.max_bucket)
+
+    def set_params(self, net_params: Any) -> None:
+        """Swap the served weights in place (the promotion pipeline's
+        live-swap primitive). The new params must share the incumbent's
+        pytree structure/shapes/dtypes — then the compiled per-bucket
+        programs are reused as-is (params are a traced argument, never
+        baked into the executable), so a swap costs one host->device
+        upload and ZERO recompiles. Shape-changing "swaps" are a
+        redeploy, not a swap: refuse loudly."""
+        old = jax.tree.structure(self._params)
+        new = jax.tree.structure(net_params)
+        if old != new:
+            raise ValueError(
+                f"param swap changed the pytree structure ({new} vs "
+                f"incumbent {old}); a structural change cannot reuse the "
+                f"compiled serving programs — redeploy instead")
+        for a, b in zip(jax.tree.leaves(self._params),
+                        jax.tree.leaves(net_params)):
+            a, b = np.asarray(a), np.asarray(b)
+            if a.shape != b.shape or a.dtype != b.dtype:
+                raise ValueError(
+                    f"param swap changed a leaf from {a.shape}/{a.dtype} "
+                    f"to {b.shape}/{b.dtype}; shape or dtype drift would "
+                    f"retrace every warmed bucket — redeploy instead")
+        self._params = jax.device_put(net_params, self._serve_sharding)
+
+    def rewarm(self) -> "tuple[int, ...]":
+        """Blessed re-warm after a :meth:`set_params` swap: re-dispatch
+        one neutral batch through EVERY warmed bucket before the engine
+        takes traffic, so any compile the swap could conceivably trigger
+        fires here rather than on a live request. With the shape-stable
+        swap contract this is a pure pipe-cleaning pass — zero compiles
+        expected, and a compile here hits a WARMED bucket, so it counts
+        as a recompile alarm (raising under ``strict``), which is
+        exactly the promotion pipeline's zero-recompile proof. Returns
+        the buckets re-driven. Requires a prior :meth:`warmup` (the
+        stored example shapes the neutral batches)."""
+        if self._example is None:
+            raise RuntimeError(
+                "rewarm() needs the example request stored by warmup(); "
+                "warm the engine before swapping params")
+        example_obs, example_mask = self._example
+        driven = []
+        for b in self.warmed_buckets:
+            obs = jax.tree.map(
+                lambda x: np.zeros((b,) + np.asarray(x).shape,
+                                   np.asarray(x).dtype), example_obs)
+            mask = jax.tree.map(
+                lambda x: np.ones((b,) + np.asarray(x).shape,
+                                  np.asarray(x).dtype), example_mask)
+            self.decide(obs, mask, np.zeros(b, np.int32))
+            driven.append(b)
+        return tuple(driven)
 
     def _emit(self, kind: str, **fields) -> None:
         if self._bus is not None:
@@ -209,6 +272,7 @@ class InferenceEngine:
         no explicit ``buckets``, warms every power of two up to
         ``max_bucket`` — after this, NO live dispatch should ever
         compile. Returns the buckets warmed by this call."""
+        self._example = (example_obs, example_mask)
         if not buckets:
             buckets = tuple(1 << i
                             for i in range(self.max_bucket.bit_length()))

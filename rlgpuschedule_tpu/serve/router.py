@@ -204,7 +204,8 @@ class EngineRouter:
                  tracer=None, n_engines: "int | None" = None, mesh=None,
                  fault_injector: "ServeFaultInjector | None" = None,
                  eject_after: int = 2, probe_backoff_s: float = 0.25,
-                 probe_backoff_max_s: float = 8.0, clock=time.monotonic):
+                 probe_backoff_max_s: float = 8.0, clock=time.monotonic,
+                 capture: bool = False):
         from ..obs import Registry
         self.registry = registry if registry is not None else Registry()
         self.tracer = tracer if tracer is not None else NULL_TRACER
@@ -223,13 +224,14 @@ class EngineRouter:
                 f"(one engine per data-axis device of the unified mesh)")
         # one engine per data-axis device, each on its own trace lane so
         # pad/dispatch spans land on per-engine tracks in the timeline
+        self.capture = bool(capture)
         self.engines = [
             InferenceEngine(
                 apply_fn, net_params, env_params, max_bucket=max_bucket,
                 registry=self.registry, bus=bus, strict=strict,
                 stall_gate=stall_gate,
                 tracer=self.tracer.lane(f"engine-{i}"),
-                device=devices[i], engine_id=i)
+                device=devices[i], engine_id=i, capture=capture)
             for i in range(n_engines)
         ]
         self.max_bucket = max_bucket
@@ -579,6 +581,32 @@ class EngineRouter:
             for cb in list(self._rewarm_listeners):
                 cb()
         return k
+
+    def swap_params(self, net_params: Any) -> "tuple[int, ...]":
+        """Live fleet-wide weight swap (the promotion pipeline's apply
+        step). EVERY engine — active or drained — gets the new params
+        (a drained engine must never rejoin with stale weights), each
+        swap under the device lock so it serializes with in-flight
+        dispatches on CPU, then every WARMED engine runs a blessed
+        :meth:`~.engine.InferenceEngine.rewarm` pass before traffic
+        resumes: with the shape-stable swap contract that pass compiles
+        nothing, and if it ever did, the compile lands on a warmed
+        bucket and counts as a recompile alarm — the promotion
+        pipeline's zero-recompile proof, not a hidden warmup. Fires the
+        rewarm listeners last (the server's learned service-time
+        estimate described the old weights' dispatch cost). Returns the
+        buckets re-driven on engine 0."""
+        driven: "tuple[int, ...]" = ()
+        for i, e in enumerate(self.engines):
+            with self._device_lock:
+                e.set_params(net_params)
+                if e.warmed_buckets:
+                    out = e.rewarm()
+                    if i == 0:
+                        driven = out
+        for cb in list(self._rewarm_listeners):
+            cb()
+        return driven
 
     def apply_autoscale(self, advisor: "AutoscaleAdvisor") -> int:
         """One autoscale tick: let ``advisor`` vote on the SLO surface,

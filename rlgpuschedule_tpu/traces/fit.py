@@ -36,8 +36,8 @@ from .records import ArrayTrace, JobRecord, to_array_trace
 from .philly_proxy import (N_VIRTUAL_CLUSTERS, PAI_GPU_PROBS, PAI_GPU_SIZES,
                            PAI_MEDIAN_DURATION_S, PAI_DURATION_SIGMA,
                            PAI_N_TENANTS, PHILLY_GPU_PROBS, PHILLY_GPU_SIZES,
-                           PHILLY_MEDIAN_DURATION_S, PHILLY_DURATION_SIGMA,
-                           _diurnal_arrivals)
+                           PHILLY_HOURLY, PHILLY_MEDIAN_DURATION_S,
+                           PHILLY_DURATION_SIGMA, _diurnal_arrivals)
 from .synthetic import DEFAULT_GPU_PROBS, DEFAULT_GPU_SIZES
 
 
@@ -53,6 +53,11 @@ class TraceFit:
     gpu_sizes: tuple[int, ...]
     gpu_probs: tuple[float, ...]
     n_tenants: int = 1
+    # hour-of-day arrival-rate multipliers (24 bins, mean ~1.0) fitted
+    # from the trace's own submit times; () = fall back to the
+    # published-statistics PHILLY_HOURLY curve when diurnal shaping is
+    # requested
+    hourly: tuple[float, ...] = ()
 
     def __post_init__(self):
         if not (math.isfinite(self.median_duration_s)
@@ -72,6 +77,15 @@ class TraceFit:
                              f"non-negative with positive mass")
         if self.n_tenants <= 0:
             raise ValueError(f"fit {self.name!r}: n_tenants must be > 0")
+        if self.hourly:
+            if len(self.hourly) != 24:
+                raise ValueError(f"fit {self.name!r}: hourly curve must "
+                                 f"have 24 bins, got {len(self.hourly)}")
+            if any(not math.isfinite(h) or h < 0 for h in self.hourly) \
+                    or max(self.hourly) <= 0:
+                raise ValueError(f"fit {self.name!r}: hourly curve must "
+                                 f"be finite, non-negative, with a "
+                                 f"positive peak")
 
     @property
     def mean_gpus(self) -> float:
@@ -84,10 +98,48 @@ class TraceFit:
                 * math.exp(0.5 * self.sigma ** 2))
 
 
+def fit_hourly_curve(submit_s: "np.ndarray | Sequence[float]",
+                     floor: float = 0.1) -> tuple[float, ...]:
+    """Fit the piecewise hour-of-day arrival curve from submit
+    timestamps (seconds; any epoch — only ``t mod 86400`` matters):
+    per-hour arrival RATES (count / seconds of that hour-of-day inside
+    the trace's span — exposure-normalized, so a span that is not a
+    whole number of days does not double-weight the hours its partial
+    day covers) normalized to mean 1.0. Deterministic — a histogram, no
+    sampling. ``floor`` clamps the relative rate of empty/uncovered
+    bins so a short trace still yields a curve the thinning sampler can
+    run (a zero bin would make those hours unreachable forever)."""
+    t = np.asarray(submit_s, np.float64)
+    if t.size == 0:
+        raise ValueError("cannot fit an hourly curve from zero arrivals")
+    if not np.all(np.isfinite(t)):
+        raise ValueError("submit times must be finite")
+    day, hour = 86400.0, 3600.0
+    hours = ((t % day) // hour).astype(np.int64)
+    counts = np.bincount(hours, minlength=24).astype(np.float64)
+    # per-bin exposure: seconds of [t0, t1] whose hour-of-day is h
+    t0, t1 = float(t.min()), float(t.max())
+    exposure = np.zeros(24, np.float64)
+    for k in range(int(t0 // day), int(t1 // day) + 1):
+        for h in range(24):
+            lo, hi = k * day + h * hour, k * day + (h + 1) * hour
+            exposure[h] += max(0.0, min(hi, t1) - max(lo, t0))
+    covered = exposure > 0
+    rate = np.zeros(24, np.float64)
+    rate[covered] = counts[covered] / exposure[covered]
+    mean_rate = rate[covered].mean() if covered.any() else 1.0
+    if mean_rate <= 0:
+        raise ValueError("cannot fit an hourly curve: zero arrival rate")
+    curve = np.full(24, float(floor))
+    curve[covered] = np.maximum(rate[covered] / mean_rate, float(floor))
+    curve = curve * (24.0 / curve.sum())   # re-center mean at 1.0
+    return tuple(float(h) for h in curve)
+
+
 def fit_jobs(jobs: Sequence[JobRecord], name: str = "fit") -> TraceFit:
     """Fit a :class:`TraceFit` from records (real CSV loads or generated
     proxies): duration median + log-std, empirical gang histogram,
-    observed tenant count."""
+    observed tenant count, hour-of-day arrival curve."""
     if not jobs:
         raise ValueError("cannot fit an empty job list")
     dur = np.asarray([j.duration for j in jobs], np.float64)
@@ -99,14 +151,16 @@ def fit_jobs(jobs: Sequence[JobRecord], name: str = "fit") -> TraceFit:
         sigma=float(np.std(np.log(dur))),
         gpu_sizes=tuple(int(s) for s in sizes),
         gpu_probs=tuple(float(c) / len(jobs) for c in counts),
-        n_tenants=int(max(j.tenant for j in jobs)) + 1)
+        n_tenants=int(max(j.tenant for j in jobs)) + 1,
+        hourly=fit_hourly_curve([j.submit for j in jobs]))
 
 
 # Published-statistics presets — identical constants to the proxy
 # generators, so the no-CSV configs get an honest fit with no sampling.
 PHILLY_FIT = TraceFit("philly", PHILLY_MEDIAN_DURATION_S,
                       PHILLY_DURATION_SIGMA, PHILLY_GPU_SIZES,
-                      PHILLY_GPU_PROBS, N_VIRTUAL_CLUSTERS)
+                      PHILLY_GPU_PROBS, N_VIRTUAL_CLUSTERS,
+                      hourly=PHILLY_HOURLY)
 PAI_FIT = TraceFit("pai", PAI_MEDIAN_DURATION_S, PAI_DURATION_SIGMA,
                    PAI_GPU_SIZES, PAI_GPU_PROBS, PAI_N_TENANTS)
 
@@ -192,7 +246,8 @@ def gen_domain_window(fit: TraceFit, n_jobs: int, seed, n_gpus: int,
     # rate = load * n_gpus / E[gpus * duration] (independent draws)
     rate = load * n_gpus / (mean_gpus * fit.mean_duration(duration_scale))
     if diurnal:
-        submit = _diurnal_arrivals(rate, n_jobs, rng)
+        submit = _diurnal_arrivals(rate, n_jobs, rng,
+                                   hourly=(fit.hourly or PHILLY_HOURLY))
     else:
         submit = np.cumsum(rng.exponential(1.0 / rate, size=n_jobs))
     n_burst = int(round(burst_frac * n_jobs))

@@ -57,8 +57,58 @@ MIN_DURATION_S = 30.0
 MAX_DURATION_S = 30 * 86400.0
 
 N_VIRTUAL_CLUSTERS = 14
-DIURNAL_AMPLITUDE = 0.5          # rate swings +-50% over a 24h cycle
 _DAY_S = 86400.0
+_HOUR_S = 3600.0
+
+# Hour-of-day arrival-rate multipliers (mean 1.0), the piecewise
+# replacement for the old +-50% sinusoid: Philly's published diurnal
+# pattern (Jeon et al. ATC'19 §3.1) is NOT sinusoidal — submissions
+# climb through the morning, plateau high across working hours with a
+# visible lunch dip, stay elevated into the evening (researchers queue
+# jobs before leaving), and trough pre-dawn. 24 bins, trough ~0.5x at
+# 04-05h, peak ~1.5x mid-afternoon.
+PHILLY_HOURLY: tuple[float, ...] = (
+    0.72, 0.62, 0.55, 0.51, 0.48, 0.50,   # 00-05: overnight trough
+    0.58, 0.74, 0.95, 1.18, 1.35, 1.42,   # 06-11: morning ramp
+    1.30, 1.38, 1.48, 1.50, 1.45, 1.38,   # 12-17: working-hour plateau
+    1.25, 1.12, 0.97, 0.90, 0.88, 0.79,   # 18-23: evening tail-off
+)
+assert abs(sum(PHILLY_HOURLY) / 24.0 - 1.0) < 1e-6, \
+    "PHILLY_HOURLY must average 1.0 so `rate` stays the mean rate"
+
+
+def _diurnal_arrivals(rate: float, n_jobs: int,
+                      rng: np.random.Generator,
+                      hourly: "Sequence[float]" = PHILLY_HOURLY,
+                      ) -> np.ndarray:
+    """Non-homogeneous Poisson arrivals at mean rate ``rate`` modulated
+    by a piecewise-constant hour-of-day curve, by thinning: candidates
+    at the peak rate ``rate * max(hourly)``, each kept with probability
+    ``rate(t)/peak`` where ``rate(t)`` reads the candidate's hour-of-day
+    bin. ``hourly`` is relative multipliers (mean ~1.0 keeps ``rate``
+    the mean rate); seeded entirely through ``rng``."""
+    curve = np.asarray(hourly, np.float64)
+    if curve.ndim != 1 or curve.size != 24:
+        raise ValueError(f"hourly curve must have 24 bins, got "
+                         f"{curve.shape}")
+    if not np.all(np.isfinite(curve)) or curve.min() < 0 \
+            or curve.max() <= 0:
+        raise ValueError("hourly curve must be finite, non-negative, "
+                         "with positive peak")
+    peak_mult = float(curve.max())
+    peak = rate * peak_mult
+    out = np.empty(0, np.float64)
+    t = 0.0
+    while out.size < n_jobs:
+        need = n_jobs - out.size
+        # oversample so one round usually suffices
+        n_cand = int(need * peak_mult * 1.2) + 16
+        cand = t + np.cumsum(rng.exponential(1.0 / peak, size=n_cand))
+        t = float(cand[-1])
+        hour = ((cand % _DAY_S) // _HOUR_S).astype(np.int64)
+        accept = curve[hour] / peak_mult
+        out = np.concatenate([out, cand[rng.random(n_cand) < accept]])
+    return out[:n_jobs]
 
 
 def _mean_gpus(sizes: Sequence[int], probs: Sequence[float]) -> float:
@@ -79,26 +129,6 @@ def base_arrival_rate(n_gpus: int, load: float,
     mean_dur = body_mean * sum(p * _STATUS_DUR_MULT[s] for s, p in
                                zip(PHILLY_STATUS, PHILLY_STATUS_PROBS))
     return load * n_gpus / (_mean_gpus(gpu_sizes, gpu_probs) * mean_dur)
-
-
-def _diurnal_arrivals(rate: float, n_jobs: int,
-                      rng: np.random.Generator) -> np.ndarray:
-    """Non-homogeneous Poisson arrivals at mean rate ``rate`` with a
-    sinusoidal diurnal cycle, by thinning: candidates at the peak rate
-    ``rate * (1 + A)``, each kept with probability rate(t)/peak."""
-    peak = rate * (1.0 + DIURNAL_AMPLITUDE)
-    out = np.empty(0, np.float64)
-    t = 0.0
-    while out.size < n_jobs:
-        need = n_jobs - out.size
-        # oversample so one round usually suffices
-        n_cand = int(need * (1.0 + DIURNAL_AMPLITUDE) * 1.2) + 16
-        cand = t + np.cumsum(rng.exponential(1.0 / peak, size=n_cand))
-        t = float(cand[-1])
-        accept = rate * (1.0 + DIURNAL_AMPLITUDE
-                         * np.sin(2.0 * np.pi * cand / _DAY_S)) / peak
-        out = np.concatenate([out, cand[rng.random(n_cand) < accept]])
-    return out[:n_jobs]
 
 
 def gen_philly_proxy_jobs(

@@ -226,6 +226,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "trusting the probe's single best")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint from --ckpt-dir")
+    # continual training from served traffic (ISSUE 19 data flywheel)
+    p.add_argument("--continual", default=None, metavar="LOGDIR",
+                   help="continual-training mode: instead of simulator "
+                        "rollouts, ingest the crc-verified served-traffic "
+                        "flight log under LOGDIR (serve --flight-log) and "
+                        "run --iterations V-trace-corrected updates over "
+                        "its pseudo-trajectories (flywheel.continual; "
+                        "default 1 iteration). Policy lag is measured per "
+                        "shard (staleness + importance-ratio gauges) and "
+                        "shards outside the trust region are refused. "
+                        "Composes with --ckpt-dir/--resume (restore the "
+                        "incumbent, retrain, save the candidate)")
+    p.add_argument("--continual-trust", type=float, default=2.0,
+                   help="ingest trust region: refuse shards whose mean "
+                        "importance ratio leaves [1/T, T]")
+    p.add_argument("--continual-rho-max", type=float, default=8.0,
+                   help="ingest trust region: refuse shards whose max "
+                        "importance ratio exceeds this")
     p.add_argument("--fused-chunk", type=int, default=1,
                    help="dispatch N train steps as one on-device scan "
                         "between hook boundaries (every active log/eval/"
@@ -565,6 +583,20 @@ def main(argv: list[str] | None = None) -> dict:
             sys.exit("--staleness-bound must be >= 0")
         if args.queue_capacity < 1:
             sys.exit("--queue-capacity must be >= 1")
+    if args.continual is None:
+        for flag, val, default in (
+                ("--continual-trust", args.continual_trust, 2.0),
+                ("--continual-rho-max", args.continual_rho_max, 8.0)):
+            if val != default:
+                sys.exit(f"{flag} tunes the --continual ingest trust "
+                         f"region; pass --continual LOGDIR with it "
+                         f"(refusing the silent no-op)")
+    else:
+        if args.continual_trust < 1.0:
+            sys.exit("--continual-trust must be >= 1.0 (the region is "
+                     "[1/T, T])")
+        if args.continual_rho_max <= 0:
+            sys.exit("--continual-rho-max must be positive")
     if args.alarms and not args.obs_dir:
         sys.exit("--alarms requires --obs-dir (alarm events need an "
                  "event stream to land in)")
@@ -596,9 +628,18 @@ def main(argv: list[str] | None = None) -> dict:
             # correction="vtrace" is gated the same as the flag
             "vtrace": cfg.algo == "ppo" and cfg.ppo.correction == "vtrace",
             "sync": not args.async_run,
+            # NOT the "vtrace" flag: continual FORCES the correction
+            # internally against measured serving lag, which is exactly
+            # the case the vtrace x sync refusal (ratios == 1 on-policy)
+            # does not cover
+            "continual": args.continual is not None,
         })
     except ModeCombinationError as e:
         sys.exit(str(e))
+    if args.continual is not None and cfg.algo != "ppo":
+        sys.exit("--continual retrains through the V-trace-corrected "
+                 "PPO pipeline; the A2C update has no importance-"
+                 "corrected variant")
     if args.source_jobs is not None:
         if args.source_jobs <= 0:
             sys.exit("--source-jobs must be positive")
@@ -683,6 +724,33 @@ def main(argv: list[str] | None = None) -> dict:
             # may have restored an older retained step than the newest dir
             print(f"resumed from step {ckpt.last_restored_step} ({meta})",
                   file=sys.stderr)
+
+        if args.continual is not None:
+            import os
+
+            from .flywheel import FlightLogError, run_continual
+            from .obs import Registry
+            registry = (telemetry.registry if telemetry is not None
+                        else Registry())
+            try:
+                summary = run_continual(
+                    exp, os.path.abspath(args.continual),
+                    iterations=(args.iterations
+                                if args.iterations is not None else 1),
+                    trust=args.continual_trust,
+                    rho_max_cap=args.continual_rho_max,
+                    registry=registry, ckpt=ckpt)
+            except FlightLogError as e:
+                sys.exit(f"continual ingest refused: {e}")
+            print(f"continual: {summary['shards_accepted']}/"
+                  f"{summary['shards_seen']} shards admitted "
+                  f"({summary['shards_refused']} refused by the trust "
+                  f"region), {summary['rows_trained']} rows as "
+                  f"{summary['pseudo_steps']} pseudo-steps x "
+                  f"{summary['iterations']} iterations -> step "
+                  f"{summary['final_step']}", file=sys.stderr)
+            print(json.dumps(summary))
+            return summary
 
         eval_kw = {}
         if args.eval_every:

@@ -1,0 +1,680 @@
+"""Data-flywheel tests (ISSUE 19): crash-safe flight log (crc
+sidecars, torn-tail vs interior-corruption semantics, served == logged
+conservation through the live server), continual V-trace ingest with
+the measured-staleness trust region, canary-gated promotion with
+hysteresis, live swap bit-identity + SLO watchdog rollback, the
+crc-sidecar'd promotion ledger, the durable event-bus mode, and the
+piecewise hour-of-day diurnal fit."""
+import dataclasses
+import json
+import os
+import types
+
+import jax
+import numpy as np
+import pytest
+
+from rlgpuschedule_tpu.algos import PPOConfig
+from rlgpuschedule_tpu.configs import CONFIGS
+from rlgpuschedule_tpu.env import env as env_lib
+from rlgpuschedule_tpu.experiment import Experiment
+from rlgpuschedule_tpu.flywheel.canary import (CanaryReport, LedgerCorruptError,
+                                               PromotionLedger, SLOWatchdog,
+                                               action_agreement, read_ledger,
+                                               replay_decisions, run_canary)
+from rlgpuschedule_tpu.flywheel.continual import (admit_shards, run_continual,
+                                                  shard_rho_stats)
+from rlgpuschedule_tpu.flywheel.flightlog import (FlightLogCorruptError,
+                                                  FlightLogError,
+                                                  FlightLogWriter,
+                                                  read_flight_log, shard_name)
+from rlgpuschedule_tpu.obs import EventBus, Registry, read_events
+from rlgpuschedule_tpu.serve import InferenceEngine, PolicyServer
+from rlgpuschedule_tpu.traces.fit import TraceFit, fit_hourly_curve, fit_jobs
+from rlgpuschedule_tpu.traces.philly_proxy import (PHILLY_HOURLY,
+                                                   _diurnal_arrivals,
+                                                   gen_philly_proxy_jobs)
+
+
+def small_cfg(**kw):
+    return dataclasses.replace(
+        CONFIGS["ppo-mlp-synth64"], n_envs=2, window_jobs=12, horizon=96,
+        n_nodes=4, gpus_per_node=4, queue_len=4,
+        ppo=PPOConfig(n_steps=8, n_epochs=1, n_minibatches=2), **kw)
+
+
+@pytest.fixture(scope="module")
+def exp():
+    """Read-only experiment: params are never mutated by these tests."""
+    return Experiment.build(small_cfg())
+
+
+@pytest.fixture(scope="module")
+def exp_cont():
+    """Continual-training experiment: run_continual advances its
+    train_state in place, so it gets its own instance."""
+    return Experiment.build(small_cfg(name="fly-cont"))
+
+
+def host_requests(exp, n=None):
+    _state, ts = env_lib.vec_reset(exp.env_params, exp.traces)
+    obs = np.asarray(jax.device_get(ts.obs))
+    mask = np.asarray(jax.device_get(ts.action_mask))
+    n = obs.shape[0] if n is None else n
+    return obs[:n], mask[:n]
+
+
+def synth_rows(n, seed=0, n_feat=5, n_act=7):
+    """Synthetic single-leaf flight-log columns (no env needed for the
+    pure write/read crash-safety tests)."""
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((n, n_feat)).astype(np.float32),
+            rng.integers(0, 2, (n, n_act)).astype(bool),
+            rng.integers(0, n_act, n).astype(np.int32),
+            rng.standard_normal(n).astype(np.float32),
+            rng.standard_normal(n).astype(np.float32),
+            np.zeros(n, np.int32),
+            rng.integers(0, 3, n).astype(np.int8))
+
+
+def write_synth_log(directory, n=20, capacity=8, seed=0, **kw):
+    obs, mask, act, lp, val, stall, oc = synth_rows(n, seed)
+    with FlightLogWriter(directory, capacity=capacity, **kw) as w:
+        # uneven batches so seals straddle append boundaries
+        for lo, hi in ((0, 7), (7, 14), (14, n)):
+            w.append_batch(obs[lo:hi], mask[lo:hi], act[lo:hi], lp[lo:hi],
+                           val[lo:hi], stall[lo:hi], oc[lo:hi])
+    return obs, mask, act, lp, val, stall, oc
+
+
+class TestFlightLog:
+    def test_roundtrip_bit_identical(self, tmp_path):
+        d = str(tmp_path / "flog")
+        reg = Registry()
+        obs, mask, act, lp, val, stall, oc = write_synth_log(
+            d, n=20, capacity=8, policy_step=17, registry=reg)
+        data = read_flight_log(d)
+        assert not data.torn_tail
+        assert [s.rows for s in data.shards] == [8, 8, 4]
+        assert all(s.policy_step == 17 for s in data.shards)
+        assert data.rows == 20
+        cat = data.concat()
+        np.testing.assert_array_equal(cat.obs_leaves[0], obs)
+        np.testing.assert_array_equal(cat.mask_leaves[0], mask)
+        np.testing.assert_array_equal(cat.act_leaves[0], act)
+        np.testing.assert_array_equal(cat.log_prob, lp)
+        np.testing.assert_array_equal(cat.value, val)
+        np.testing.assert_array_equal(cat.outcome, oc)
+        assert cat.policy_step == 17
+        rendered = reg.render()
+        assert "flywheel_rows_logged_total 20" in rendered
+        assert "flywheel_shards_sealed_total 3" in rendered
+
+    def test_rows_logged_counts_sealed_plus_buffered(self, tmp_path):
+        obs, mask, act, lp, val, stall, oc = synth_rows(5)
+        w = FlightLogWriter(str(tmp_path), capacity=4)
+        w.append_batch(obs, mask, act, lp, val, stall, oc)
+        assert w.rows_logged == 5 and w.shards_sealed == 1
+        w.close()
+        assert w.shards_sealed == 2       # tail sealed on close
+        with pytest.raises(FlightLogError, match="closed"):
+            w.append_batch(obs, mask, act, lp, val, stall, oc)
+        w.close()                         # idempotent
+
+    def test_seal_event_uses_shard_not_seq(self, tmp_path):
+        """Regression: the seal event's payload key must not shadow the
+        bus's reserved `seq` stamp field — emit() raises on shadowing,
+        and a raise inside a dispatch pump once stranded futures."""
+        bus = EventBus(str(tmp_path / "obs"))
+        try:
+            write_synth_log(str(tmp_path / "flog"), n=8, capacity=8,
+                            policy_step=3, bus=bus)
+        finally:
+            bus.close()
+        seals = [e for e in read_events(bus.path)
+                 if e["kind"] == "flywheel_shard_seal"]
+        assert [e["shard"] for e in seals] == [0]
+        assert seals[0]["rows"] == 8 and seals[0]["policy_step"] == 3
+
+    def test_torn_tail_dropped_and_flagged(self, tmp_path):
+        d = str(tmp_path)
+        write_synth_log(d, n=20, capacity=8)
+        os.remove(os.path.join(d, ".crc", "shard-000002.json"))
+        data = read_flight_log(d)
+        assert data.torn_tail and "shard-000002" in data.torn_reason
+        assert [s.seq for s in data.shards] == [0, 1]
+        assert data.rows == 16
+
+    def test_truncated_tail_payload_is_torn(self, tmp_path):
+        d = str(tmp_path)
+        write_synth_log(d, n=20, capacity=8)
+        path = os.path.join(d, shard_name(2))
+        blob = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(blob[:len(blob) // 2])   # kill mid-write
+        data = read_flight_log(d)
+        assert data.torn_tail and len(data.shards) == 2
+
+    def test_interior_corruption_raises(self, tmp_path):
+        d = str(tmp_path)
+        write_synth_log(d, n=20, capacity=8)
+        path = os.path.join(d, shard_name(0))
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(path, "wb").write(bytes(blob))
+        with pytest.raises(FlightLogCorruptError, match="crc32 mismatch"):
+            read_flight_log(d)
+
+    def test_interior_missing_sidecar_raises(self, tmp_path):
+        d = str(tmp_path)
+        write_synth_log(d, n=20, capacity=8)
+        os.remove(os.path.join(d, ".crc", "shard-000001.json"))
+        with pytest.raises(FlightLogCorruptError, match="non-tail"):
+            read_flight_log(d)
+
+    def test_tmp_leftovers_ignored(self, tmp_path):
+        d = str(tmp_path)
+        write_synth_log(d, n=8, capacity=8)
+        open(os.path.join(d, "shard-000001.npz.tmp.999"), "wb").write(b"x")
+        data = read_flight_log(d)
+        assert not data.torn_tail and data.rows == 8
+
+    def test_capacity_validates(self, tmp_path):
+        with pytest.raises(ValueError, match="capacity"):
+            FlightLogWriter(str(tmp_path), capacity=0)
+
+    def test_empty_log_refuses_concat(self, tmp_path):
+        data = read_flight_log(str(tmp_path))
+        assert data.shards == [] and not data.torn_tail
+        with pytest.raises(FlightLogError, match="empty"):
+            data.concat()
+
+
+class TestServedConservation:
+    def make_server(self, exp, tmp_path, registry, **log_kw):
+        engine = InferenceEngine(exp.apply_fn, exp.train_state.params,
+                                 exp.env_params, max_bucket=8,
+                                 registry=registry, capture=True)
+        obs, mask = host_requests(exp)
+        engine.warmup(obs[0], mask[0])
+        writer = FlightLogWriter(str(tmp_path / "flog"), registry=registry,
+                                 **log_kw)
+        return PolicyServer(engine, registry=registry,
+                            flight_log=writer), writer, engine
+
+    def test_served_rows_equal_logged_rows_bit_identically(self, exp,
+                                                           tmp_path):
+        reg = Registry()
+        server, writer, engine = self.make_server(
+            exp, tmp_path, reg, capacity=6,
+            policy_step=int(exp.train_state.step))
+        obs, mask = host_requests(exp)
+        futs = [server.submit(obs[i % 2], mask[i % 2]) for i in range(10)]
+        while server.pump():
+            pass
+        served = [f.result(timeout=30) for f in futs]
+        server.close()
+        writer.close()
+        # conservation: every served row is logged, nothing else is
+        assert writer.rows_logged == len(served) == 10
+        data = read_flight_log(str(tmp_path / "flog"))
+        assert not data.torn_tail and data.rows == 10
+        cat = data.concat()
+        np.testing.assert_array_equal(
+            cat.act_leaves[0],
+            np.stack([np.asarray(r.action) for r in served]))
+        np.testing.assert_array_equal(cat.obs_leaves[0],
+                                      np.stack([obs[i % 2]
+                                                for i in range(10)]))
+        assert cat.policy_step == int(exp.train_state.step)
+        # the logged behavior columns replay bit-identically under the
+        # incumbent: the canary's reference leg is exact by construction
+        rep = run_canary(exp.apply_fn, exp.train_state.params,
+                         exp.train_state.params, cat, obs[0], mask[0],
+                         env_params=exp.env_params)
+        assert rep.verdict == "promote"
+        assert rep.incumbent_agreement == 1.0
+        assert rep.candidate_agreement == 1.0
+
+    def test_flight_log_requires_capture_engine(self, exp, tmp_path):
+        engine = InferenceEngine(exp.apply_fn, exp.train_state.params,
+                                 exp.env_params, max_bucket=8)
+        with pytest.raises(ValueError, match="capture"):
+            PolicyServer(engine,
+                         flight_log=FlightLogWriter(str(tmp_path)))
+
+    def test_failing_append_fails_futures_loudly(self, exp, tmp_path):
+        """Regression: a raising flight-log append must resolve the
+        batch's futures with the exception — the background dispatcher
+        swallows pump errors on the assumption the pump already did, so
+        anything else strands clients in result() forever."""
+        reg = Registry()
+        server, writer, _ = self.make_server(exp, tmp_path, reg)
+
+        def boom(*a, **kw):
+            raise RuntimeError("disk gone")
+
+        writer.append_batch = boom
+        obs, mask = host_requests(exp)
+        server.start()
+        try:
+            fut = server.submit(obs[0], mask[0])
+            with pytest.raises(RuntimeError, match="disk gone"):
+                fut.result(timeout=30)
+        finally:
+            server.stop()
+            server.close()
+
+
+class TestCanaryGate:
+    @pytest.fixture(scope="class")
+    def flip_obs(self, exp):
+        """An observation where negated params flip the full-mask greedy
+        action — the deterministic 'regressed candidate' probe."""
+        obs, mask = host_requests(exp)
+        full = np.ones_like(mask[0])
+        neg = jax.tree.map(lambda x: -x, exp.train_state.params)
+        for row in obs:
+            a0, _, _ = replay_decisions(exp.apply_fn, exp.train_state.params,
+                                        row[None], full[None], None)
+            a1, _, _ = replay_decisions(exp.apply_fn, neg, row[None],
+                                        full[None], None)
+            if not action_agreement(a0, a1)[0]:
+                return row, full, neg
+        pytest.fail("no probe observation flips under negated params")
+
+    def make_window(self, exp, flip_obs, flip_slices, n=80, slices=8):
+        """A window whose rows force agreement except inside
+        ``flip_slices``: forced rows carry a one-hot mask (any policy
+        must pick the single legal action), flip rows carry a full mask
+        at an observation where the negated candidate provably departs
+        from the incumbent. Logged actions = the incumbent's replay, so
+        the incumbent leg is exact."""
+        from rlgpuschedule_tpu.flywheel.flightlog import FlightShard
+        row, full, _ = flip_obs
+        per = n // slices
+        obs = np.repeat(row[None], n, axis=0)
+        mask = np.zeros((n,) + full.shape, full.dtype)
+        mask[:, 0] = True                       # forced: only action 0
+        for s in flip_slices:
+            mask[s * per:(s + 1) * per] = True  # free: candidate departs
+        act, lp, val = replay_decisions(exp.apply_fn, exp.train_state.params,
+                                        obs, mask, None)
+        return FlightShard(
+            seq=0, path="<synth>", rows=n,
+            policy_step=int(exp.train_state.step),
+            obs_leaves=[obs], mask_leaves=[mask],
+            act_leaves=[np.asarray(a) for a in jax.tree.leaves(act)],
+            log_prob=np.asarray(lp), value=np.asarray(val),
+            stall=np.zeros(n, np.int32), outcome=np.zeros(n, np.int8))
+
+    def test_regressed_candidate_blocked_with_evidence(self, exp, flip_obs,
+                                                       tmp_path):
+        reg = Registry()
+        bus = EventBus(str(tmp_path))
+        window = self.make_window(exp, flip_obs, flip_slices=range(8))
+        try:
+            rep = run_canary(exp.apply_fn, exp.train_state.params,
+                             flip_obs[2], window, flip_obs[0][None][0],
+                             flip_obs[1], registry=reg, bus=bus)
+        finally:
+            bus.close()
+        assert rep.verdict == "blocked"
+        assert rep.incumbent_agreement == 1.0
+        assert rep.candidate_agreement < 1.0
+        assert rep.max_regress_streak >= 2
+        rendered = reg.render()
+        assert "flywheel_canary_runs_total 1" in rendered
+        assert "flywheel_promotions_blocked_total 1" in rendered
+        kinds = [e["kind"] for e in read_events(bus.path)]
+        assert "promote_blocked" in kinds
+
+    def test_single_regressing_slice_promotes(self, exp, flip_obs):
+        """Hysteresis: one noisy slice cannot veto a candidate."""
+        window = self.make_window(exp, flip_obs, flip_slices=[3])
+        rep = run_canary(exp.apply_fn, exp.train_state.params, flip_obs[2],
+                         window, flip_obs[0], flip_obs[1])
+        assert rep.verdict == "promote"
+        assert rep.regress_slices == 1 and rep.max_regress_streak == 1
+
+    def test_consecutive_regressing_slices_block(self, exp, flip_obs):
+        reg = Registry()
+        window = self.make_window(exp, flip_obs, flip_slices=[3, 4])
+        rep = run_canary(exp.apply_fn, exp.train_state.params, flip_obs[2],
+                         window, flip_obs[0], flip_obs[1], registry=reg)
+        assert rep.verdict == "blocked" and rep.max_regress_streak == 2
+        assert rep.regress_slices == 2
+
+    def test_incumbent_is_the_reference_not_absolute_agreement(self, exp,
+                                                               flip_obs):
+        """A slice where the LOG disagrees with everyone (behavior
+        snapshot older than the incumbent) penalizes both legs equally
+        — the candidate is judged relative to the incumbent."""
+        window = self.make_window(exp, flip_obs, flip_slices=[])
+        # corrupt the logged actions on slice 0: nobody can agree there
+        window.act_leaves = [np.array(l) for l in window.act_leaves]
+        for leaf in window.act_leaves:
+            leaf[:10] = (leaf[:10] + 1) % 2
+        rep = run_canary(exp.apply_fn, exp.train_state.params,
+                         exp.train_state.params, window, flip_obs[0],
+                         flip_obs[1])
+        assert rep.verdict == "promote"
+        assert rep.incumbent_agreement < 1.0
+        assert rep.candidate_agreement == rep.incumbent_agreement
+
+    def test_validates_knobs(self, exp, flip_obs):
+        window = self.make_window(exp, flip_obs, flip_slices=[])
+        with pytest.raises(ValueError, match="slices"):
+            run_canary(exp.apply_fn, exp.train_state.params,
+                       exp.train_state.params, window, flip_obs[0],
+                       flip_obs[1], slices=0)
+        with pytest.raises(ValueError, match="hysteresis"):
+            run_canary(exp.apply_fn, exp.train_state.params,
+                       exp.train_state.params, window, flip_obs[0],
+                       flip_obs[1], hysteresis=0)
+
+
+class TestSwapAndWatchdog:
+    def test_swap_rewarm_zero_recompiles_and_rollback_bit_identity(
+            self, exp):
+        reg = Registry()
+        engine = InferenceEngine(exp.apply_fn, exp.train_state.params,
+                                 exp.env_params, max_bucket=8,
+                                 registry=reg, strict=True)
+        obs, mask = host_requests(exp)
+        warmed = engine.warmup(obs[0], mask[0])
+        incumbent = exp.train_state.params
+        before, _ = engine.decide(obs, mask)
+        candidate = jax.tree.map(lambda x: x + 0.125, incumbent)
+        engine.set_params(candidate)
+        assert engine.rewarm() == warmed      # blessed pass, every bucket
+        assert engine.post_warmup_recompiles == 0
+        # rollback restores the incumbent program bit-identically
+        engine.set_params(incumbent)
+        assert engine.rewarm() == warmed
+        after, _ = engine.decide(obs, mask)
+        for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert engine.post_warmup_recompiles == 0
+
+    def test_shape_changing_swap_refused(self, exp):
+        engine = InferenceEngine(exp.apply_fn, exp.train_state.params,
+                                 exp.env_params, max_bucket=8)
+        with pytest.raises(ValueError):
+            engine.set_params({"not": np.zeros(3, np.float32)})
+
+    def make_watchdog(self, tmp_path=None, **kw):
+        reg = Registry()
+        eng = types.SimpleNamespace(post_warmup_recompiles=0)
+        bus = EventBus(str(tmp_path)) if tmp_path is not None else None
+        wd = SLOWatchdog(reg, engine=eng, p99_factor=1.5, breach_after=2,
+                         bus=bus, **kw)
+        return wd, reg, eng, bus
+
+    def test_p99_breach_streak_requests_rollback(self, tmp_path):
+        wd, reg, _, bus = self.make_watchdog(tmp_path)
+        g = reg.gauge("serve_decision_latency_p99_ms")
+        try:
+            g.set(10.0)
+            for _ in range(3):
+                wd.sample_baseline()
+            wd.arm()
+            g.set(11.0)
+            assert not wd.observe()["rollback"]     # within 1.5x
+            g.set(100.0)
+            tick = wd.observe()
+            assert not tick["rollback"] and tick["streak"] == 1
+            tick = wd.observe()
+            assert tick["rollback"] and tick["streak"] == 2
+            assert any("p99" in r for r in tick["reasons"])
+        finally:
+            bus.close()
+        kinds = [e["kind"] for e in read_events(bus.path)]
+        assert "promote_rollback" in kinds
+
+    def test_breach_streak_resets_on_a_clean_tick(self):
+        wd, reg, _, _ = self.make_watchdog()
+        g = reg.gauge("serve_decision_latency_p99_ms")
+        g.set(10.0)
+        wd.sample_baseline()
+        wd.arm()
+        g.set(100.0)
+        assert wd.observe()["streak"] == 1
+        g.set(10.0)
+        assert wd.observe()["streak"] == 0      # hysteresis reset
+        g.set(100.0)
+        assert not wd.observe()["rollback"]     # streak restarts at 1
+
+    def test_post_swap_recompile_is_immediate_rollback(self):
+        wd, reg, eng, _ = self.make_watchdog()
+        reg.gauge("serve_decision_latency_p99_ms").set(10.0)
+        wd.sample_baseline()
+        wd.arm()
+        eng.post_warmup_recompiles = 1
+        tick = wd.observe()
+        assert tick["rollback"] and any("recompile" in r
+                                        for r in tick["reasons"])
+
+    def test_new_shedding_votes_breach(self):
+        wd, reg, _, _ = self.make_watchdog()
+        reg.gauge("serve_decision_latency_p99_ms").set(10.0)
+        shed = reg.counter("serve_shed_total")
+        shed.inc(5)                       # pre-swap shed is not counted
+        wd.sample_baseline()
+        wd.arm()
+        assert wd.observe()["streak"] == 0
+        shed.inc()
+        assert wd.observe()["streak"] == 1
+        shed.inc()
+        assert wd.observe()["rollback"]
+
+    def test_validates_and_orders(self):
+        reg = Registry()
+        with pytest.raises(ValueError, match="p99_factor"):
+            SLOWatchdog(reg, p99_factor=1.0)
+        with pytest.raises(ValueError, match="breach_after"):
+            SLOWatchdog(reg, breach_after=0)
+        wd = SLOWatchdog(reg)
+        with pytest.raises(RuntimeError, match="arm"):
+            wd.observe()
+
+
+class TestPromotionLedger:
+    def test_roundtrip_and_tail_semantics(self, tmp_path):
+        d = str(tmp_path)
+        led = PromotionLedger(d, durable=False)
+        for i, ev in enumerate(("canary", "promote", "rollback")):
+            led.append({"event": ev, "step": i})
+        sealed, tail = read_ledger(d)
+        assert [e["event"] for e in sealed] == ["canary", "promote",
+                                                "rollback"]
+        assert tail == []
+        # an append that died before the sidecar rewrite: parseable but
+        # outside the integrity contract -> surfaced as the tail
+        with open(led.path, "a") as f:
+            f.write(json.dumps({"event": "late"}) + "\n")
+        sealed, tail = read_ledger(d)
+        assert len(sealed) == 3 and [e["event"] for e in tail] == ["late"]
+        # a TORN final line parses to nothing but is not fatal
+        with open(led.path, "a") as f:
+            f.write('{"event": "to')
+        sealed, tail = read_ledger(d)
+        assert len(sealed) == 3 and len(tail) == 1
+
+    def test_corrupt_sealed_prefix_raises(self, tmp_path):
+        d = str(tmp_path)
+        led = PromotionLedger(d)
+        led.append({"event": "promote"})
+        blob = bytearray(open(led.path, "rb").read())
+        blob[2] ^= 0xFF
+        open(led.path, "wb").write(bytes(blob))
+        with pytest.raises(LedgerCorruptError):
+            read_ledger(d)
+
+    def test_missing_ledger_is_empty(self, tmp_path):
+        assert read_ledger(str(tmp_path / "nope")) == ([], [])
+
+
+class TestContinualIngest:
+    def write_served_log(self, exp, directory, n=64, capacity=16,
+                         lp_shift=0.0, policy_step=None):
+        """A flight log of real served-style rows whose behavior columns
+        come from the experiment's own params (rho == 1 exactly unless
+        ``lp_shift`` poisons the stored behavior log-probs)."""
+        obs1, mask1 = host_requests(exp)
+        reps = n // obs1.shape[0]
+        obs = np.tile(obs1, (reps, 1))
+        mask = np.tile(mask1, (reps, 1))
+        act, lp, val = replay_decisions(exp.apply_fn, exp.train_state.params,
+                                        obs, mask, None, exp.env_params)
+        step = (int(exp.train_state.step) if policy_step is None
+                else policy_step)
+        with FlightLogWriter(directory, capacity=capacity,
+                             policy_step=step) as w:
+            w.append_batch(obs, mask, act, np.asarray(lp) + lp_shift,
+                           val, np.zeros(n, np.int32),
+                           np.ones(n, np.int8))
+        return n
+
+    def test_on_policy_log_ingests_and_trains(self, exp_cont, tmp_path):
+        d = str(tmp_path / "flog")
+        self.write_served_log(exp_cont, d, n=64, capacity=16)
+        reg = Registry()
+        step0 = int(exp_cont.train_state.step)
+        summary = run_continual(exp_cont, d, iterations=2, registry=reg)
+        assert summary["mode"] == "continual"
+        assert summary["shards_seen"] == summary["shards_accepted"] == 4
+        assert summary["shards_refused"] == 0
+        assert not summary["torn_tail"]
+        assert summary["rows_logged"] == summary["rows_accepted"] == 64
+        # folded [T, E] geometry: 64 rows over 2 lanes, tiling the
+        # minibatch count
+        assert summary["pseudo_steps"] == 32
+        assert summary["rows_trained"] == 64
+        # behavior == target params at ingest time -> rho is exactly 1
+        for shard in summary["per_shard"]:
+            assert shard["accepted"] and shard["staleness"] == 0
+            assert shard["rho_mean"] == pytest.approx(1.0, abs=1e-4)
+        # two optimizer updates per iteration (1 epoch x 2 minibatches)
+        assert summary["final_step"] == step0 + 4
+        assert 0.5 < summary["rho_mean_trained"] < 2.0
+        rendered = reg.render()
+        assert "flywheel_shards_ingested_total 4" in rendered
+        assert "flywheel_shards_refused_total 0" in rendered
+        assert "flywheel_shard_staleness 0" in rendered
+
+    def test_off_policy_shards_refused_by_trust_region(self, exp_cont,
+                                                       tmp_path):
+        """Stored behavior log-probs 4 nats above the target's put rho
+        ~ e^-4, far outside [1/trust, trust]: every shard is refused
+        and the run fails loudly instead of training on noise."""
+        d = str(tmp_path / "poisoned")
+        self.write_served_log(exp_cont, d, n=32, capacity=16, lp_shift=4.0)
+        reg = Registry()
+        with pytest.raises(FlightLogError, match="trust region"):
+            run_continual(exp_cont, d, registry=reg)
+        assert "flywheel_shards_refused_total 2" in reg.render()
+
+    def test_mixed_log_trains_on_admitted_shards_only(self, exp_cont,
+                                                      tmp_path):
+        d = str(tmp_path / "mixed")
+        obs1, mask1 = host_requests(exp_cont)
+        obs = np.tile(obs1, (16, 1))
+        mask = np.tile(mask1, (16, 1))
+        act, lp, val = replay_decisions(
+            exp_cont.apply_fn, exp_cont.train_state.params, obs, mask,
+            None, exp_cont.env_params)
+        with FlightLogWriter(d, capacity=32,
+                             policy_step=int(exp_cont.train_state.step)) as w:
+            w.append_batch(obs, mask, act, lp, val,
+                           np.zeros(32, np.int32), np.ones(32, np.int8))
+            w.append_batch(obs, mask, act, np.asarray(lp) + 4.0, val,
+                           np.zeros(32, np.int32), np.ones(32, np.int8))
+        summary = run_continual(exp_cont, d, iterations=1)
+        assert summary["shards_seen"] == 2
+        assert summary["shards_accepted"] == 1
+        assert summary["shards_refused"] == 1
+        assert summary["rows_accepted"] == summary["rows_trained"] == 32
+        accepted = [s for s in summary["per_shard"] if s["accepted"]]
+        assert [s["seq"] for s in accepted] == [0]
+
+    def test_empty_log_refuses(self, exp_cont, tmp_path):
+        with pytest.raises(FlightLogError, match="no verified shards"):
+            run_continual(exp_cont, str(tmp_path))
+
+    def test_trust_knob_validates(self, exp_cont, tmp_path):
+        self.write_served_log(exp_cont, str(tmp_path / "f"), n=8,
+                              capacity=8)
+        with pytest.raises(ValueError, match="trust"):
+            run_continual(exp_cont, str(tmp_path / "f"), trust=0.5)
+
+
+class TestDurableEventBus:
+    def test_durable_mode_survives_torn_final_write(self, tmp_path):
+        bus = EventBus(str(tmp_path), durable=True)
+        bus.emit("promote_apply", step=1)
+        bus.emit("promote_rollback", step=2)
+        bus.close()
+        # a killed writer's one reachable bad state: a torn last line
+        with open(bus.path, "a") as f:
+            f.write('{"kind": "promote_app')
+        events = read_events(bus.path)
+        assert [e["kind"] for e in events] == ["promote_apply",
+                                               "promote_rollback"]
+        assert [e["seq"] for e in events] == [0, 1]
+
+    def test_emit_refuses_reserved_stamp_fields(self, tmp_path):
+        """The contract the flight log's seal event once tripped over:
+        payload keys must not shadow the bus's own stamp fields."""
+        bus = EventBus(str(tmp_path))
+        try:
+            with pytest.raises(ValueError, match="seq"):
+                bus.emit("flywheel_shard_seal", seq=0)
+            with pytest.raises(ValueError, match="wall"):
+                bus.emit("x", wall=1.0)
+        finally:
+            bus.close()
+
+
+class TestDiurnalFit:
+    def test_philly_hourly_curve_shape(self):
+        assert len(PHILLY_HOURLY) == 24
+        assert sum(PHILLY_HOURLY) == pytest.approx(24.0, abs=1e-9)
+        # working-hours peak, small-hours trough — piecewise, not a
+        # symmetric sinusoid
+        assert max(PHILLY_HOURLY) == max(PHILLY_HOURLY[9:18])
+        assert min(PHILLY_HOURLY) == min(PHILLY_HOURLY[0:7])
+
+    def test_fit_round_trips_the_generating_curve(self):
+        rng = np.random.default_rng(0)
+        submit = _diurnal_arrivals(0.02, 5000, rng, hourly=PHILLY_HOURLY)
+        curve = fit_hourly_curve(submit)
+        assert len(curve) == 24
+        assert sum(curve) == pytest.approx(24.0, abs=1e-6)
+        err = np.abs(np.asarray(curve) - np.asarray(PHILLY_HOURLY))
+        assert err.max() < 0.2
+
+    def test_fit_is_deterministic(self):
+        a = _diurnal_arrivals(0.02, 2000, np.random.default_rng(7),
+                              hourly=PHILLY_HOURLY)
+        b = _diurnal_arrivals(0.02, 2000, np.random.default_rng(7),
+                              hourly=PHILLY_HOURLY)
+        np.testing.assert_array_equal(a, b)
+        assert fit_hourly_curve(a) == fit_hourly_curve(b)
+
+    def test_fit_jobs_carries_the_hourly_curve(self):
+        jobs = gen_philly_proxy_jobs(3000, seed=3, n_gpus=256)
+        fit = fit_jobs(jobs, "roundtrip")
+        assert len(fit.hourly) == 24
+        assert sum(fit.hourly) == pytest.approx(24.0, abs=1e-6)
+        assert max(fit.hourly) > 1.2 * min(fit.hourly)
+
+    def test_fit_hourly_validates(self):
+        with pytest.raises(ValueError, match="zero arrivals"):
+            fit_hourly_curve([])
+        with pytest.raises(ValueError, match="finite"):
+            fit_hourly_curve([0.0, np.inf])
+
+    def test_tracefit_rejects_bad_hourly(self):
+        with pytest.raises(ValueError, match="24 bins"):
+            TraceFit("x", 100.0, 1.0, (1,), (1.0,), hourly=(1.0, 2.0))
