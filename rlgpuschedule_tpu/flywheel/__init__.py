@@ -24,8 +24,9 @@ promotion.
 from .canary import (CanaryReport, LedgerCorruptError, PromotionLedger,
                      SLOWatchdog, action_agreement, read_ledger,
                      replay_decisions, run_canary)
-from .continual import (IngestReport, admit_shards, run_continual,
-                        shard_rho_stats, shards_to_transition)
+from .continual import (IngestReport, admit_shards, gate_logged_mask,
+                        run_continual, shard_rho_stats,
+                        shards_to_transition)
 from .flightlog import (FlightLogCorruptError, FlightLogData,
                         FlightLogError, FlightLogWriter, FlightShard,
                         read_flight_log, unflatten_like)
@@ -34,7 +35,8 @@ __all__ = [
     "CanaryReport", "FlightLogCorruptError", "FlightLogData",
     "FlightLogError", "FlightLogWriter", "FlightShard", "IngestReport",
     "LedgerCorruptError", "PromotionLedger", "SLOWatchdog",
-    "action_agreement", "admit_shards", "read_flight_log", "read_ledger",
-    "replay_decisions", "run_canary", "run_continual", "shard_rho_stats",
-    "shards_to_transition", "unflatten_like",
+    "action_agreement", "admit_shards", "gate_logged_mask",
+    "read_flight_log", "read_ledger", "replay_decisions", "run_canary",
+    "run_continual", "shard_rho_stats", "shards_to_transition",
+    "unflatten_like",
 ]

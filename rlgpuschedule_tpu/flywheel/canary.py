@@ -34,6 +34,7 @@ import dataclasses
 import json
 import os
 import threading
+import weakref
 from typing import Any
 
 import jax
@@ -55,19 +56,33 @@ class LedgerCorruptError(RuntimeError):
 
 # one jitted replay program per (policy, stall-gate) pair — the
 # _GATHER_CACHE idiom, so repeated canary runs against the same
-# apply_fn reuse the compiled executable instead of re-tracing per call
-_REPLAY_PROGRAMS: "dict[tuple, Any]" = {}
+# apply_fn reuse the compiled executable instead of re-tracing per
+# call. WEAK-keyed on apply_fn: each entry pins a jitted executable,
+# so a strong key would leak one per Experiment build in a long-lived
+# process — the cache must die with the policy it serves. The cached
+# closure holds apply_fn through a weakref too: a strong ref in the
+# VALUE would keep the weak KEY alive and defeat the eviction
+_REPLAY_PROGRAMS: "weakref.WeakKeyDictionary[Any, dict]" = (
+    weakref.WeakKeyDictionary())
 
 
 def _replay_program(apply_fn, thresh: int, gated: bool):
-    key = (apply_fn, thresh, gated)
-    fn = _REPLAY_PROGRAMS.get(key)
+    try:
+        per_fn = _REPLAY_PROGRAMS.get(apply_fn)
+        if per_fn is None:
+            per_fn = _REPLAY_PROGRAMS[apply_fn] = {}
+        fn_ref = weakref.ref(apply_fn)
+    except TypeError:        # un-weakref-able callable: trace per call
+        per_fn, fn_ref = {}, (lambda af=apply_fn: af)
+    key = (thresh, gated)
+    fn = per_fn.get(key)
     if fn is None:
         def _replay(p, o, m, s, pre):
+            af = fn_ref()    # live: the caller holds apply_fn
             if gated:
                 m = gate_stalled(m, s, thresh, pre)
-            return policy_decision_full(apply_fn, p, o, m)
-        fn = _REPLAY_PROGRAMS[key] = jax.jit(_replay)
+            return policy_decision_full(af, p, o, m)
+        fn = per_fn[key] = jax.jit(_replay)
     return fn
 
 

@@ -41,7 +41,8 @@ import numpy as np
 from ..algos import action_dist
 from ..algos.ppo import make_learn_step
 from ..algos.rollout import Transition
-from ..decision import greedy_actions
+from ..decision import (gate_stalled, greedy_actions, preempt_slice,
+                        stall_threshold)
 from .flightlog import (FlightLogData, FlightLogError, FlightShard,
                         read_flight_log, unflatten_like)
 
@@ -57,14 +58,37 @@ class IngestReport:
     per_shard: "list[dict]"
 
 
+def gate_logged_mask(mask: Any, stall, env_params):
+    """Re-apply the serving engines' stall gate to a logged PRE-gate
+    mask column. The stored behavior log-prob/value came out of the
+    engine's compiled program AFTER :func:`..decision.gate_stalled`, so
+    any target distribution compared against it (ρ-stats, the learn
+    step's log-probs) must see the SAME gated mask — exactly what the
+    canary's ``replay_decisions`` already does. No-op when the env has
+    no preempt actions (hier env / preempt_len == 0): the engine gate
+    is a no-op there too."""
+    pre = (preempt_slice(env_params) if env_params is not None else None)
+    if pre is None:
+        return mask
+    thresh = stall_threshold(env_params)
+    return np.asarray(jax.device_get(gate_stalled(
+        mask, np.asarray(stall, np.int32), thresh, pre)))
+
+
 def shard_rho_stats(apply_fn, params, shard: FlightShard,
                     example_obs: Any, example_mask: Any,
-                    example_act: Any) -> "tuple[float, float]":
+                    example_act: Any, env_params=None,
+                    ) -> "tuple[float, float]":
     """(mean, max) unclipped importance ratios of ``shard`` under the
     learner's current ``params`` — one batched apply, target log-prob
-    against the shard's stored behavior log-prob."""
+    against the shard's stored behavior log-prob. ``env_params`` (when
+    given) re-applies the serving stall gate to the logged pre-gate
+    mask so the target distribution matches the one the behavior
+    log-prob was drawn from."""
     obs = unflatten_like(example_obs, shard.obs_leaves)
-    mask = unflatten_like(example_mask, shard.mask_leaves)
+    mask = gate_logged_mask(
+        unflatten_like(example_mask, shard.mask_leaves), shard.stall,
+        env_params)
     act = unflatten_like(example_act, shard.act_leaves)
     logits, _ = apply_fn(params, obs, mask)
     target_lp = action_dist.log_prob(logits, act)
@@ -76,7 +100,8 @@ def shard_rho_stats(apply_fn, params, shard: FlightShard,
 def admit_shards(data: FlightLogData, apply_fn, params, learner_step: int,
                  example_obs: Any, example_mask: Any, example_act: Any,
                  trust: float = 2.0, rho_max_cap: float = 8.0,
-                 registry=None) -> "tuple[list[FlightShard], IngestReport]":
+                 registry=None, env_params=None,
+                 ) -> "tuple[list[FlightShard], IngestReport]":
     """Trust-region admission over every verified shard. Returns the
     accepted shards (seq order) and the per-shard report; publishes the
     staleness/ρ gauges and the refusal counter when a registry rides
@@ -109,7 +134,8 @@ def admit_shards(data: FlightLogData, apply_fn, params, learner_step: int,
     for s in data.shards:
         stale = int(learner_step) - s.policy_step
         rho_mean, rho_max = shard_rho_stats(
-            apply_fn, params, s, example_obs, example_mask, example_act)
+            apply_fn, params, s, example_obs, example_mask, example_act,
+            env_params=env_params)
         ok = (1.0 / trust <= rho_mean <= trust
               and rho_max <= rho_max_cap)
         if g_stale is not None:
@@ -137,19 +163,28 @@ def _fold_rows(leaves: "list[np.ndarray]", T: int, E: int):
 def shards_to_transition(shards: "list[FlightShard]", n_envs: int,
                          tile: int, example_obs: Any,
                          example_mask: Any, example_act: Any,
+                         env_params=None,
                          ) -> "tuple[Transition, jax.Array, int]":
     """Fold accepted shards' rows into one ``[T, E]`` Transition (row
     ``t*E + e`` → step t, lane e; the tail remainder that cannot fill a
     step — and any steps past the largest ``T`` whose flattened batch
     tiles ``tile`` (the update geometry's minibatch size or count) — is
-    dropped, counted by the caller via ``rows - T*E``). Returns
-    ``(transition, last_value[E], T)``."""
+    dropped, counted by the caller via ``rows - T*E``). The Transition
+    mask is the logged mask with the serving stall gate re-applied
+    (``env_params`` given): the stored behavior log-prob is defined
+    over the GATED action set, and the learn step's ratio needs the
+    same support. Returns ``(transition, last_value[E], T)``."""
     if not shards:
         raise FlightLogError("no shards survived the ingest trust region")
     E = int(n_envs)
     cat = lambda ls: [np.concatenate(x) for x in zip(*ls)]
     obs_l = cat([s.obs_leaves for s in shards])
-    mask_l = cat([s.mask_leaves for s in shards])
+    stall_cat = np.concatenate([s.stall for s in shards])
+    mask_rows = gate_logged_mask(
+        unflatten_like(example_mask,
+                       cat([s.mask_leaves for s in shards])),
+        stall_cat, env_params)
+    mask_l = [np.asarray(l) for l in jax.tree.leaves(mask_rows)]
     act_l = cat([s.act_leaves for s in shards])
     lp = np.concatenate([s.log_prob for s in shards])
     value = np.concatenate([s.value for s in shards])
@@ -203,12 +238,13 @@ def run_continual(exp, logdir: str, iterations: int = 1, *,
     accepted, report = admit_shards(
         data, exp.apply_fn, exp.train_state.params, learner_step,
         ex_obs, ex_mask, ex_act, trust=trust, rho_max_cap=rho_max_cap,
-        registry=registry)
+        registry=registry, env_params=exp.env_params)
     algo = dataclasses.replace(exp.cfg.ppo, correction="vtrace")
     tile = (algo.minibatch_size if algo.minibatch_size is not None
             else algo.n_minibatches)
     tr, last_value, T = shards_to_transition(
-        accepted, exp.cfg.n_envs, tile, ex_obs, ex_mask, ex_act)
+        accepted, exp.cfg.n_envs, tile, ex_obs, ex_mask, ex_act,
+        env_params=exp.env_params)
     # the learn step's flatten reads n_steps from the config — bind it
     # to the folded T (data decides the geometry here, not the config)
     algo = dataclasses.replace(algo, n_steps=T)

@@ -351,6 +351,28 @@ def read_flight_log(directory: str) -> FlightLogData:
         if m:
             found.append((int(m.group(1)), os.path.join(directory, name)))
     found.sort()
+    # the writer numbers shards 0..N-1 with no holes, so a seq gap in
+    # what survived on disk means an INTERIOR shard file vanished (with
+    # its sidecar) — data loss the per-file crc checks cannot see. A
+    # lost TAIL shard is detectable too: its sidecar (written after the
+    # payload) outlives the payload
+    for i, (seq, _) in enumerate(found):
+        if seq != i:
+            raise FlightLogCorruptError(
+                f"{directory}: shard seq {i} is missing (found "
+                f"{shard_name(seq)} after {i} earlier shard(s)) — "
+                f"interior data loss, not a torn tail")
+    crc_dir = os.path.join(directory, ".crc")
+    if os.path.isdir(crc_dir):
+        side_seqs = sorted(
+            int(m.group(1)) for m in
+            (re.fullmatch(r"shard-(\d{6})\.json", n)
+             for n in os.listdir(crc_dir)) if m)
+        if side_seqs and side_seqs[-1] >= len(found):
+            raise FlightLogCorruptError(
+                f"{directory}: sidecar for seq {side_seqs[-1]} exists "
+                f"but only {len(found)} shard payload(s) remain — a "
+                f"sealed shard was lost after publication")
     shards: "list[FlightShard]" = []
     torn, reason = False, ""
     for i, (seq, path) in enumerate(found):

@@ -520,12 +520,6 @@ class PolicyServer:
                 "(capture=True): the log's behavior log-prob and value "
                 "columns come out of the engine's compiled decision "
                 "program, never a post-hoc recompute")
-        # outcome scratch: one dispatch's deadline outcomes, reused
-        # every batch (the arena discipline — the flight log copies the
-        # rows out before the next dispatch can overwrite the slice)
-        self._outcome_scratch = (
-            np.zeros(int(engine.max_bucket), np.int8)
-            if flight_log is not None else None)
         self.registry = registry if registry is not None else Registry()
         self.tracer = tracer if tracer is not None else NULL_TRACER
         if max_wait_s is not None and max_wait_s < 0:
@@ -1051,8 +1045,12 @@ class PolicyServer:
         a dispatch, so the log's row count equals ``serve_dispatches``'
         served total exactly — the flywheel's conservation contract."""
         import jax
-        outcome = self._outcome_scratch[:n]
-        outcome[:] = 0
+        # per-call outcome buffer, NOT a shared scratch: N dispatcher
+        # threads reach here concurrently outside self._lock, and the
+        # flight log only copies rows under ITS lock — a shared slab
+        # would let one thread's fill interleave with another's copy
+        # jsan: disable=alloc-in-hot-loop -- n int8s per dispatch (noise next to this call's obs/mask slab memcpys); a shared scratch raced across dispatcher threads
+        outcome = np.zeros(n, np.int8)
         for i, d in enumerate(deads):
             if d is not None:
                 outcome[i] = 1 if lats[i] <= d else 2

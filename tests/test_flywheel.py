@@ -22,11 +22,13 @@ from rlgpuschedule_tpu.flywheel.canary import (CanaryReport, LedgerCorruptError,
                                                PromotionLedger, SLOWatchdog,
                                                action_agreement, read_ledger,
                                                replay_decisions, run_canary)
-from rlgpuschedule_tpu.flywheel.continual import (admit_shards, run_continual,
+from rlgpuschedule_tpu.flywheel.continual import (admit_shards,
+                                                  gate_logged_mask,
+                                                  run_continual,
                                                   shard_rho_stats)
 from rlgpuschedule_tpu.flywheel.flightlog import (FlightLogCorruptError,
                                                   FlightLogError,
-                                                  FlightLogWriter,
+                                                  FlightLogWriter, FlightShard,
                                                   read_flight_log, shard_name)
 from rlgpuschedule_tpu.obs import EventBus, Registry, read_events
 from rlgpuschedule_tpu.serve import InferenceEngine, PolicyServer
@@ -170,6 +172,27 @@ class TestFlightLog:
         write_synth_log(d, n=20, capacity=8)
         os.remove(os.path.join(d, ".crc", "shard-000001.json"))
         with pytest.raises(FlightLogCorruptError, match="non-tail"):
+            read_flight_log(d)
+
+    def test_interior_missing_shard_raises(self, tmp_path):
+        """A seq gap (interior shard file lost WITH its sidecar) is
+        data loss, not a torn tail — per-file crc checks cannot see it,
+        the contiguity check must."""
+        d = str(tmp_path)
+        write_synth_log(d, n=20, capacity=8)
+        os.remove(os.path.join(d, shard_name(1)))
+        os.remove(os.path.join(d, ".crc", "shard-000001.json"))
+        with pytest.raises(FlightLogCorruptError, match="missing"):
+            read_flight_log(d)
+
+    def test_lost_sealed_tail_raises(self, tmp_path):
+        """A tail shard whose payload vanished AFTER publication leaves
+        its sidecar behind (payload-then-sidecar ordering) — that is
+        loss of sealed data, not the at-most-one torn tail."""
+        d = str(tmp_path)
+        write_synth_log(d, n=20, capacity=8)
+        os.remove(os.path.join(d, shard_name(2)))
+        with pytest.raises(FlightLogCorruptError, match="lost"):
             read_flight_log(d)
 
     def test_tmp_leftovers_ignored(self, tmp_path):
@@ -607,6 +630,91 @@ class TestContinualIngest:
                               capacity=8)
         with pytest.raises(ValueError, match="trust"):
             run_continual(exp_cont, str(tmp_path / "f"), trust=0.5)
+
+
+class TestContinualGateParity:
+    """The stored behavior log-prob comes out of the engine's GATED
+    decision program; the continual path must measure ρ against the
+    same gated distribution (the canary's replay already does)."""
+
+    @pytest.fixture(scope="class")
+    def exp_pre(self):
+        return Experiment.build(small_cfg(name="fly-gate", preempt_len=2))
+
+    def test_rho_is_one_only_under_the_replayed_stall_gate(self, exp_pre):
+        from rlgpuschedule_tpu.decision import (preempt_slice,
+                                                stall_threshold)
+        exp = exp_pre
+        pre = preempt_slice(exp.env_params)
+        assert pre is not None
+        thresh = stall_threshold(exp.env_params)
+        obs, mask = host_requests(exp)
+        mask = np.ones_like(mask)              # preempt actions live
+        stall = np.full(mask.shape[0], thresh, np.int32)  # gate fires
+        act, blp, val = replay_decisions(
+            exp.apply_fn, exp.train_state.params, obs, mask, stall,
+            exp.env_params)
+        shard = FlightShard(
+            seq=0, path="<mem>", rows=int(obs.shape[0]),
+            policy_step=int(exp.train_state.step),
+            obs_leaves=[obs], mask_leaves=[mask],
+            act_leaves=[np.asarray(l) for l in jax.tree.leaves(act)],
+            log_prob=np.asarray(blp), value=np.asarray(val),
+            stall=stall, outcome=np.zeros(obs.shape[0], np.int8))
+        ex_act = jax.tree.map(lambda l: np.asarray(l)[:1], act)
+        gated_mean, gated_max = shard_rho_stats(
+            exp.apply_fn, exp.train_state.params, shard, obs[:1],
+            mask[:1], ex_act, env_params=exp.env_params)
+        # zero staleness + the replayed gate => exactly on-policy
+        np.testing.assert_allclose([gated_mean, gated_max], 1.0,
+                                   rtol=1e-4)
+        raw_mean, _ = shard_rho_stats(
+            exp.apply_fn, exp.train_state.params, shard, obs[:1],
+            mask[:1], ex_act)
+        # the PRE-gate mask renormalizes over actions the engine never
+        # had => ratios are wrong even at zero staleness
+        assert abs(raw_mean - 1.0) > 1e-3
+
+    def test_gate_logged_mask_matches_engine_gate(self, exp_pre):
+        from rlgpuschedule_tpu.decision import (gate_stalled,
+                                                preempt_slice,
+                                                stall_threshold)
+        exp = exp_pre
+        pre = preempt_slice(exp.env_params)
+        thresh = stall_threshold(exp.env_params)
+        _, mask = host_requests(exp)
+        mask = np.ones_like(mask)
+        stall = np.asarray([thresh, 0], np.int32)[:mask.shape[0]]
+        got = gate_logged_mask(mask, stall, exp.env_params)
+        want = np.asarray(jax.device_get(
+            gate_stalled(mask, stall, thresh, pre)))
+        np.testing.assert_array_equal(got, want)
+        assert not got[0].all()                # stalled row was gated
+        # no env_params / no preempt actions: explicit no-op
+        np.testing.assert_array_equal(
+            gate_logged_mask(mask, stall, None), mask)
+
+
+class TestReplayProgramCache:
+    def test_weakly_keyed_no_pin_after_apply_fn_dies(self):
+        """Regression: the jitted-replay cache must not pin apply_fn
+        (and its executable) forever — one entry per Experiment build
+        in a long-lived process was unbounded growth."""
+        import gc
+        import weakref
+        from rlgpuschedule_tpu.flywheel.canary import (_REPLAY_PROGRAMS,
+                                                       _replay_program)
+
+        def apply_fn(p, o, m):
+            return o, o
+        prog = _replay_program(apply_fn, 3, True)
+        assert _replay_program(apply_fn, 3, True) is prog   # cache hit
+        assert _replay_program(apply_fn, 3, False) is not prog
+        assert apply_fn in _REPLAY_PROGRAMS
+        ref = weakref.ref(apply_fn)
+        del apply_fn, prog
+        gc.collect()
+        assert ref() is None                    # entry did not pin it
 
 
 class TestDurableEventBus:
