@@ -108,6 +108,7 @@ VTRACE_OBS_DIR=$(mktemp -d /tmp/ci_vtrace_obs.XXXXXX)
 SERVE_OBS_DIR=$(mktemp -d /tmp/ci_serve_obs.XXXXXX)
 SOAK_OBS_DIR=$(mktemp -d /tmp/ci_soak_obs.XXXXXX)
 CHAOS_SOAK_OBS_DIR=$(mktemp -d /tmp/ci_chaos_soak_obs.XXXXXX)
+CHAOS_FLOG_DIR=$(mktemp -d /tmp/ci_chaos_flog.XXXXXX)
 CHAOS_JSON=$(mktemp /tmp/ci_chaos.XXXXXX.json)
 SERVE_JSON=$(mktemp /tmp/ci_serve.XXXXXX.json)
 SOAK_JSON=$(mktemp /tmp/ci_soak.XXXXXX.json)
@@ -116,6 +117,7 @@ TRACE_JSON=$(mktemp /tmp/ci_trace.XXXXXX.json)
 HOST_PATH_JSON=$(mktemp /tmp/ci_host_path.XXXXXX.json)
 trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$VTRACE_OBS_DIR" \
     "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_SOAK_OBS_DIR" \
+    "$CHAOS_FLOG_DIR" \
     "$CHAOS_JSON" "$SERVE_JSON" "$SOAK_JSON" "$CHAOS_SOAK_JSON" \
     "$TRACE_JSON" "$HOST_PATH_JSON"' EXIT
 # --trace-spans rides along (ISSUE 11): the flight recorder must not
@@ -274,6 +276,7 @@ MATRIX_CLEAN_DIR=$(mktemp -d /tmp/ci_matrix_clean.XXXXXX)
 MATRIX_JSON=$(mktemp /tmp/ci_matrix.XXXXXX.json)
 trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$VTRACE_OBS_DIR" \
     "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_SOAK_OBS_DIR" \
+    "$CHAOS_FLOG_DIR" \
     "$CHAOS_JSON" "$SERVE_JSON" "$SOAK_JSON" "$CHAOS_SOAK_JSON" \
     "$TRACE_JSON" \
     "$MATRIX_OBS_DIR" "$MATRIX_CKPT_DIR" "$MATRIX_CLEAN_DIR" \
@@ -475,6 +478,12 @@ echo "=== smoke: chaos-soak (engine faults mid-run, HTTP front door, 2 CPU devic
 # recompiles per engine, bound the p99 drift, land the full
 # eject/readmit/retry lifecycle on the event bus, and prove the drain
 # contract on the wire (late submit -> typed refusal, connect refused).
+# ISSUE 20 rides along: request-id conservation BY IDENTITY from the
+# merged instant stream (every submitted id resolves exactly once as
+# served | shed | dispatch_failed), an engine-health slo_burn_alert
+# during the fault window with slo_burn_clear + budget recovery after,
+# and a single-request timeline reconstruction (report --request) for
+# a live sampled id joined against the flight log.
 # NOTE: no --autoscale — the chaos soak does not drive the advisor
 # loop, and the CLI refuses the combination outright.
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
@@ -486,13 +495,14 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
     --queue-len 4 --horizon 64 \
     --chaos-faults "engine-raise@40:engine=0,engine-raise@40:engine=0" \
     --frontend-port 0 \
+    --flight-log "$CHAOS_FLOG_DIR" --flight-capacity 64 \
     --obs-dir "$CHAOS_SOAK_OBS_DIR" --trace-spans \
     --metrics-port 0 > "$CHAOS_SOAK_JSON"
 timeout -k 10 60 env JAX_PLATFORMS=cpu \
     python -m rlgpuschedule_tpu.obs.report "$CHAOS_SOAK_OBS_DIR" \
     --strict-alarms --trace-out "$TRACE_JSON" > /dev/null
 validate_trace "$TRACE_JSON" chaos-soak
-python - "$CHAOS_SOAK_JSON" "$CHAOS_SOAK_OBS_DIR" <<'EOF'
+python - "$CHAOS_SOAK_JSON" "$CHAOS_SOAK_OBS_DIR" "$CHAOS_FLOG_DIR" <<'EOF'
 import json, sys
 from rlgpuschedule_tpu.obs import merge_dir
 rep = json.load(open(sys.argv[1]))
@@ -539,6 +549,67 @@ prom = open(sys.argv[2] + "/metrics.prom").read()
 # per-engine ejection series needs a runtime grep.
 assert 'serve_engine_ejections_total{engine="0"}' in prom, \
     "missing scrape series: serve_engine_ejections_total"
+for name in ("serve_queue_wait_seconds_bucket", "slo_burn_rate",
+             "slo_error_budget_remaining", "slo_burn_alerts_total"):
+    assert name in prom, f"missing scrape series: {name}"
+
+# ---- ISSUE 20: request-id conservation BY IDENTITY -----------------
+# the count invariant above cannot see a dropped-and-double-served
+# pair; ids can. submitted = enqueued + admission-shed (admission
+# sheds never reach the queue, so never emit enqueue); resolved =
+# served + shed (any reason) + dispatch_failed — exactly once each.
+events = merge_dir(sys.argv[2])
+pts = [e for e in events if e.get("kind") == "span_point"]
+enq = [e["attrs"]["req_id"] for e in pts if e.get("span") == "enqueue"]
+served_ids = [r for e in pts if e.get("span") == "served"
+              for r in e["attrs"]["req_ids"]]
+shed_pts = [(e["attrs"]["req_id"], e["attrs"]["reason"])
+            for e in pts if e.get("span") == "shed"]
+failed_ids = [r for e in pts if e.get("span") == "dispatch_failed"
+              for r in e["attrs"]["req_ids"]]
+submitted = enq + [r for r, why in shed_pts if why == "admission"]
+resolved = served_ids + failed_ids + [r for r, _ in shed_pts]
+assert len(submitted) == len(set(submitted)), "duplicate submit ids"
+assert sorted(resolved) == sorted(submitted), (
+    f"request-id conservation violated: {len(submitted)} submitted, "
+    f"{len(resolved)} resolved, "
+    f"symmetric diff {len(set(submitted) ^ set(resolved))}")
+# the soak's own futures are a subset (the frontend selfcheck adds a
+# couple of front-door requests after the pacing loop)
+assert len(submitted) >= s["requests"], (len(submitted), s["requests"])
+assert all(i > 0 for i in submitted), "unassigned (0) id leaked"
+
+# ---- ISSUE 20: burn alert during the fault window, recovery after --
+faults = [e for e in events if e["kind"] == "serve_fault"]
+eh_alerts = [e for e in events if e["kind"] == "slo_burn_alert"
+             and e["slo"] == "engine-health"]
+eh_clears = [e for e in events if e["kind"] == "slo_burn_clear"
+             and e["slo"] == "engine-health"]
+assert eh_alerts, "no engine-health slo_burn_alert under injected faults"
+assert faults and eh_alerts[0]["mono"] >= faults[0]["mono"], \
+    "burn alert predates the first injected fault"
+assert eh_alerts[0]["mono"] <= faults[-1]["mono"] + 2.0, \
+    "burn alert fired long after the fault window (stale scrape?)"
+assert eh_alerts[0]["burns"] and all(
+    b >= 1.0 for b in eh_alerts[0]["burns"].values()), eh_alerts[0]
+assert eh_clears and eh_clears[-1]["mono"] > eh_alerts[0]["mono"], \
+    "burn alert never cleared after the bleeding stopped"
+slo = s["slo"]["engine-health"]
+assert not slo["alerting"] and slo["alerts_total"] >= 1, slo
+assert slo["budget_remaining"] > 0.5, (
+    f"engine-health budget did not recover: {slo}")
+
+# ---- ISSUE 20: single-request timeline reconstruction --------------
+# a live served id must reconstruct end to end: stages + the flight-log
+# shard/row it landed in (report exits 1 if the id appears nowhere)
+import subprocess
+rid = served_ids[len(served_ids) // 2]
+r = subprocess.run(
+    [sys.executable, "-m", "rlgpuschedule_tpu.obs.report", sys.argv[2],
+     "--request", f"0x{rid:x}", "--flight-log", sys.argv[3]],
+    capture_output=True, text=True, timeout=60)
+assert r.returncode == 0, (rid, r.stdout, r.stderr)
+assert "logged:" in r.stdout, r.stdout
 print("chaos-soak smoke ok:", {
     "requests": s["requests"], "shed": s["shed"],
     "faults_fired": s["faults_fired"],
@@ -546,7 +617,11 @@ print("chaos-soak smoke ok:", {
     "readmissions": fs["readmissions"],
     "retry_hedges": fs["retry_hedges"],
     "rss_growth": (None if g is None else round(g, 4)),
-    "frontend": fe["post_drain_connect"]})
+    "frontend": fe["post_drain_connect"],
+    "ids_conserved": len(submitted),
+    "burn_alerts": len(eh_alerts),
+    "budget_recovered": round(slo["budget_remaining"], 3),
+    "traced_request": f"0x{rid:x}"})
 EOF
 
 echo "=== smoke: sharding (rule-mesh train + PBT-on-mesh, 2 CPU devices) ==="
@@ -561,6 +636,7 @@ MESH_JSON=$(mktemp /tmp/ci_mesh.XXXXXX.json)
 PBT_JSON=$(mktemp /tmp/ci_pbt.XXXXXX.json)
 trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$VTRACE_OBS_DIR" \
     "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_SOAK_OBS_DIR" \
+    "$CHAOS_FLOG_DIR" \
     "$CHAOS_JSON" "$SERVE_JSON" "$SOAK_JSON" "$CHAOS_SOAK_JSON" \
     "$TRACE_JSON" \
     "$MATRIX_OBS_DIR" "$MATRIX_CKPT_DIR" "$MATRIX_CLEAN_DIR" \
@@ -622,6 +698,7 @@ echo "=== smoke: data flywheel (flight log -> continual retrain -> canary promot
 FLY_DIR=$(mktemp -d /tmp/ci_flywheel.XXXXXX)
 trap 'rm -rf "$OBS_DIR" "$ASYNC_OBS_DIR" "$VTRACE_OBS_DIR" \
     "$SERVE_OBS_DIR" "$SOAK_OBS_DIR" "$CHAOS_SOAK_OBS_DIR" \
+    "$CHAOS_FLOG_DIR" \
     "$CHAOS_JSON" "$SERVE_JSON" "$SOAK_JSON" "$CHAOS_SOAK_JSON" \
     "$TRACE_JSON" \
     "$MATRIX_OBS_DIR" "$MATRIX_CKPT_DIR" "$MATRIX_CLEAN_DIR" \

@@ -31,6 +31,11 @@ column          meaning
 ``stall``       the client's consecutive-zero-dt count (i32)
 ``outcome``     deadline outcome (i8): 0 = no deadline, 1 = met,
                 2 = served late (resolved past its SLO but not shed)
+``req_id``      request-causality id (i64, ISSUE 20): the 64-bit key
+                minted at the front door — joins a logged row back to
+                its serve-side span/instant events, the request's
+                wire frames, and any canary verdict that replayed it
+                (0 = pre-v2 row / id-less submit)
 ``policy_step`` scalar i64: the behavior policy's train step (staleness
                 numerator for the ingest trust region)
 ==============  =======================================================
@@ -109,6 +114,7 @@ class FlightLogWriter:
         self._value = np.zeros(capacity, np.float32)
         self._stall = np.zeros(capacity, np.int32)
         self._outcome = np.zeros(capacity, np.int8)
+        self._req = np.zeros(capacity, np.int64)
         self._n = 0
         self._seq = 0
         self._seq_rows = 0       # rows already sealed to disk
@@ -146,16 +152,25 @@ class FlightLogWriter:
         self._obs, self._mask, self._act = mk(obs_l), mk(mask_l), mk(act_l)
 
     def append_batch(self, obs: Any, mask: Any, actions: Any,
-                     log_prob, value, stall, outcome) -> None:
+                     log_prob, value, stall, outcome,
+                     req_id=None) -> None:
         """Append one dispatch's rows (leading axis = rows; pytrees for
         ``obs``/``mask``/``actions``). Copies into the recycled buffer;
-        seals as many full shards as the batch fills."""
+        seals as many full shards as the batch fills. ``req_id`` is the
+        per-row causality-id column (``None`` — id-less callers —
+        writes zeros, the "unassigned" sentinel)."""
         obs_l, mask_l, act_l = _leaves(obs), _leaves(mask), _leaves(actions)
         lp = np.asarray(log_prob, np.float32)
         val = np.asarray(value, np.float32)
         st = np.asarray(stall, np.int32)
         oc = np.asarray(outcome, np.int8)
         n = int(lp.shape[0])
+        rid = (np.zeros(n, np.int64) if req_id is None
+               else np.asarray(req_id, np.int64))
+        if rid.shape != (n,):
+            raise ValueError(
+                f"req_id must be one id per row: got shape {rid.shape} "
+                f"for {n} rows")
         with self._lock:
             if self._closed:
                 raise FlightLogError("FlightLogWriter is closed")
@@ -175,6 +190,7 @@ class FlightLogWriter:
                 self._value[s:e] = val[off:off + m]
                 self._stall[s:e] = st[off:off + m]
                 self._outcome[s:e] = oc[off:off + m]
+                self._req[s:e] = rid[off:off + m]
                 self._n += m
                 off += m
                 if self._n == self.capacity:
@@ -199,6 +215,7 @@ class FlightLogWriter:
         cols["value"] = self._value[:n]
         cols["stall"] = self._stall[:n]
         cols["outcome"] = self._outcome[:n]
+        cols["req_id"] = self._req[:n]
         cols["policy_step"] = np.int64(self.policy_step)
         path = os.path.join(self.directory, shard_name(seq))
         tmp = f"{path}.tmp.{os.getpid()}"
@@ -271,6 +288,8 @@ class FlightShard:
     value: np.ndarray
     stall: np.ndarray
     outcome: np.ndarray
+    # LAST + defaulted: pre-ISSUE-20 call sites construct positionally
+    req_id: "np.ndarray | None" = None
 
 
 @dataclasses.dataclass
@@ -301,7 +320,10 @@ class FlightLogData:
             log_prob=np.concatenate([s.log_prob for s in self.shards]),
             value=np.concatenate([s.value for s in self.shards]),
             stall=np.concatenate([s.stall for s in self.shards]),
-            outcome=np.concatenate([s.outcome for s in self.shards]))
+            outcome=np.concatenate([s.outcome for s in self.shards]),
+            req_id=np.concatenate(
+                [s.req_id if s.req_id is not None
+                 else np.zeros(s.rows, np.int64) for s in self.shards]))
 
 
 def unflatten_like(example: Any, leaves: "list[np.ndarray]") -> Any:
@@ -330,7 +352,11 @@ def _load_shard(directory: str, seq: int, path: str) -> FlightShard:
             policy_step=int(meta["policy_step"]),
             obs_leaves=grab("obs"), mask_leaves=grab("mask"),
             act_leaves=grab("act"), log_prob=z["log_prob"],
-            value=z["value"], stall=z["stall"], outcome=z["outcome"])
+            value=z["value"], stall=z["stall"], outcome=z["outcome"],
+            # pre-ISSUE-20 shards have no req_id column: read as
+            # all-zeros ("unassigned") instead of failing the load
+            req_id=(z["req_id"] if "req_id" in z.files
+                    else np.zeros(int(meta["rows"]), np.int64)))
     if shard.rows != int(shard.log_prob.shape[0]):
         raise FlightLogCorruptError(
             f"{os.path.basename(path)}: sidecar says {shard.rows} rows, "

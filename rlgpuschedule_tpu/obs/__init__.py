@@ -16,6 +16,11 @@ to *when and on which rank*.
   Prometheus-text snapshot file (``metrics.prom``) and a live stdlib
   HTTP scrape endpoint (:func:`serve_http` — what the serving CLI's
   ``--metrics-port`` exposes).
+- :mod:`.slo` — declarative SLO specs evaluated as multi-window burn
+  rates over cumulative SLIs (ISSUE 20), refreshed by the registry's
+  pre-scrape collector hook: ``slo_burn_rate`` /
+  ``slo_error_budget_remaining`` gauges (the budget recovers as the
+  window slides past an incident) and edge-triggered bus alerts.
 - :mod:`.telemetry` — :class:`RunTelemetry` (what ``Experiment.run`` /
   ``PopulationExperiment.run`` hold: iteration spans with a
   rollout+update/sync/eval/ckpt phase breakdown, zero added host syncs)
@@ -55,6 +60,11 @@ Event kinds by emitter:
    CLI promotion driver), ``promote_rollback`` (SLO watchdog) — none
    are alarm kinds, so a healthy promotion keeps ``--strict-alarms``
    green
+== SLO engine (:mod:`.slo`): ``slo_burn_alert`` (every burn window of a
+   spec over threshold — rising edge) and ``slo_burn_clear`` (falling
+   edge, budget recovering) — deliberately not alarm kinds either:
+   ``--strict-alarms`` stays a compile/transfer contract while SLO
+   health alerts on its own channel
 """
 from .events import (EventBus, SCHEMA_VERSION, event_streams, merge_dir,
                      merge_events, read_events)
@@ -62,6 +72,7 @@ from .metrics import (Counter, Gauge, Histogram, MetricsHTTPServer,
                       Registry, serve_http)
 from .skew import (RankSkew, correct_events, learn_offsets,
                    merge_dir_corrected)
+from .slo import DEFAULT_WINDOWS, SLOEngine, SLOSpec, histogram_sli
 from .telemetry import AlarmError, Alarms, RunTelemetry
 from .trace import (NULL_TRACER, Tracer, async_overlap_summary,
                     build_span_tree, to_chrome_trace, tracer_of)
@@ -75,4 +86,5 @@ __all__ = [
     "NULL_TRACER", "Tracer", "async_overlap_summary", "build_span_tree",
     "to_chrome_trace", "tracer_of",
     "RankSkew", "correct_events", "learn_offsets", "merge_dir_corrected",
+    "DEFAULT_WINDOWS", "SLOEngine", "SLOSpec", "histogram_sli",
 ]

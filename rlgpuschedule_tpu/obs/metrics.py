@@ -154,6 +154,46 @@ class Registry:
         # (kind + help) is resolved from the first-registered series
         self._metrics: dict[tuple[str, str],
                             Union[Counter, Gauge, Histogram]] = {}
+        # pre-scrape collector hooks (ISSUE 20): callables run by
+        # collect() before every render, so derived gauges (SLO burn
+        # rates, reservoir percentiles) are recomputed at scrape time
+        # instead of whenever someone last remembered to refresh them
+        self._collectors: list = []
+        self._in_collect = False
+        self.collector_errors = 0
+
+    def add_collector(self, fn) -> None:
+        """Register a zero-arg callable to run before every render/
+        scrape. Collectors refresh derived series from primary state;
+        they must be cheap and must not raise (a raising collector is
+        swallowed and counted in ``collector_errors`` — a broken
+        refresher must never take the scrape surface down with it)."""
+        if fn not in self._collectors:
+            self._collectors.append(fn)
+
+    def remove_collector(self, fn) -> None:
+        """Deregister a collector (no-op if absent) — call on shutdown
+        of the subsystem that owns the refreshed series."""
+        try:
+            self._collectors.remove(fn)
+        except ValueError:
+            pass
+
+    def collect(self) -> None:
+        """Run every registered collector once. Re-entrancy-guarded: a
+        collector that (transitively) triggers another render observes
+        the in-progress refresh instead of recursing."""
+        if not self._collectors or self._in_collect:
+            return
+        self._in_collect = True
+        try:
+            for fn in list(self._collectors):
+                try:
+                    fn()
+                except Exception:
+                    self.collector_errors += 1
+        finally:
+            self._in_collect = False
 
     def _register(self, cls, name: str, help: str,
                   labels: "dict[str, str] | None" = None):
@@ -211,7 +251,9 @@ class Registry:
         family, then one value line per series (label-suffixed when the
         series is labeled) or the cumulative
         ``_bucket``/``_sum``/``_count`` series per histogram;
-        (name, labels)-sorted for a stable diffable snapshot."""
+        (name, labels)-sorted for a stable diffable snapshot. Runs the
+        registered collectors first — a scrape is never stale."""
+        self.collect()
         lines = []
         last_family = None
         for name, suffix in sorted(self._metrics):

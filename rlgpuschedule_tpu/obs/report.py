@@ -21,7 +21,22 @@ timeline and prints the run's post-mortem:
 - steps/s curve (one row per logged iteration);
 - chaos story (``env_fault`` events from ``evaluate --chaos``): the
   regime × scheduler degradation cells, in one table;
+- flywheel & fleet health (ISSUE 20): promotion verdicts
+  (``promote_blocked`` / ``promote_apply`` / ``promote_rollback``),
+  serving-fleet lifecycle (``serve_fault`` / ``engine_eject`` /
+  ``engine_readmit`` / ``serve_retry``) and SLO burn alerts
+  (``slo_burn_alert`` / ``slo_burn_clear``), in timeline order;
 - alarm summary (``recompile`` / ``transfer`` / ``slow_iteration``).
+
+``--request ID`` switches to the single-request post-mortem: the
+request id (as minted by the server or carried on the ``X-Request-Id``
+header / v2 frame field) is joined across the serve instants
+(``enqueue`` → ``served``/``shed``/``dispatch_failed``, with queue wait
+and end-to-end latency from the dispatch record), the flight log
+(``--flight-log`` — which sealed shard/row holds the logged decision
+and its deadline outcome), and the promotion ledger in the same
+directory (which canary verdicts replayed a window covering that row).
+Exit 1 when the id appears nowhere.
 
 Exit codes: 0 ok, 1 no events under the directory (an empty post-mortem
 must fail loudly), 2 usage. ``--strict-alarms`` additionally exits 1
@@ -51,6 +66,14 @@ _HISTORY_KINDS = (
     "ckpt_crc_reject", "ckpt_elastic_restore", "worker_resumed",
 )
 
+# the serving-fleet + flywheel story: promotion verdicts, engine
+# lifecycle, SLO burn alerts (none are alarm kinds)
+_FLEET_KINDS = (
+    "promote_blocked", "promote_apply", "promote_rollback",
+    "serve_fault", "engine_eject", "engine_readmit", "serve_retry",
+    "slo_burn_alert", "slo_burn_clear",
+)
+
 
 def build_report(events: list[dict]) -> dict:
     """Aggregate a merged timeline into the post-mortem's sections."""
@@ -73,6 +96,7 @@ def build_report(events: list[dict]) -> dict:
                       "wall_s": e.get("wall_s")})
 
     history = [e for e in events if e.get("kind") in _HISTORY_KINDS]
+    fleet = [e for e in events if e.get("kind") in _FLEET_KINDS]
     restores = [e for e in events if e.get("kind") == "ckpt_restore"]
     chaos = [{"regime": e.get("regime"), "scheduler": e.get("scheduler"),
               "avg_jct": e.get("avg_jct"),
@@ -92,12 +116,133 @@ def build_report(events: list[dict]) -> dict:
     return {"schema_versions": versions, "ranks": ranks,
             "n_events": len(events), "span_s": span_s, "t0_mono": t0,
             "phase_seconds": phases, "steps_curve": curve,
-            "history": history, "ckpt_restores": restores,
+            "history": history, "fleet": fleet,
+            "ckpt_restores": restores,
             "chaos": chaos, "alarms": alarms, "kind_counts": counts,
             "span_tree": span_tree,
             "torn_spans": sum(n["open"] for n in span_tree),
             "async_overlap": (async_overlap_summary(events)
                               if has_spans else None)}
+
+
+# flight-log deadline-outcome codes (flywheel.flightlog schema)
+_OUTCOME_NAMES = {0: "no-deadline", 1: "met", 2: "served-late"}
+
+
+def build_request_report(events: list[dict], req_id: int,
+                         flight_dir: "str | None" = None) -> dict:
+    """Join one request id across the serve instants, the flight log,
+    and the promotion ledger — the single-request timeline.
+
+    Stages come from the batching tier's ``span_point`` instants:
+    ``enqueue`` (admission), then exactly one of ``served`` (with the
+    per-row queue wait and end-to-end latency the dispatch recorded),
+    ``shed`` (admission or in-queue expiry), or ``dispatch_failed``.
+    With ``flight_dir`` the id is also looked up in the sealed shards'
+    ``req_id`` column (which shard/row logged the decision) and — via
+    the row's global position — matched against ledger entries whose
+    canary window covered it."""
+    req_id = int(req_id)
+    stages = []
+
+    def stage(name, e, **extra):
+        stages.append(dict({"stage": name, "mono": e.get("mono"),
+                            "rank": e.get("rank", 0)}, **extra))
+
+    for e in events:
+        if e.get("kind") != "span_point":
+            continue
+        a = e.get("attrs") or {}
+        span = e.get("span")
+        if span == "enqueue" and a.get("req_id") == req_id:
+            stage("enqueue", e, stall=a.get("stall"))
+        elif span == "shed" and a.get("req_id") == req_id:
+            stage("shed", e, reason=a.get("reason"))
+        elif span in ("served", "dispatch_failed"):
+            rids = a.get("req_ids") or []
+            if req_id not in rids:
+                continue
+            if span == "served":
+                i = rids.index(req_id)
+                waits = a.get("wait_ms") or []
+                lats = a.get("lat_ms") or []
+                stage("served", e, bucket=a.get("bucket"),
+                      batch_rows=len(rids),
+                      queue_wait_ms=waits[i] if i < len(waits) else None,
+                      latency_ms=lats[i] if i < len(lats) else None)
+            else:
+                stage("dispatch_failed", e, error=a.get("error"))
+
+    flight = None
+    verdicts: list[dict] = []
+    if flight_dir:
+        import numpy as np
+
+        from ..flywheel.canary import read_ledger
+        from ..flywheel.flightlog import read_flight_log
+        data = read_flight_log(flight_dir)
+        preceding = 0
+        for s in data.shards:
+            if s.req_id is not None:
+                for i in np.flatnonzero(s.req_id == req_id):
+                    i = int(i)
+                    flight = {"shard_seq": s.seq, "path": s.path,
+                              "row": i, "global_row": preceding + i,
+                              "outcome": int(s.outcome[i]),
+                              "outcome_name": _OUTCOME_NAMES.get(
+                                  int(s.outcome[i]), "?")}
+            preceding += s.rows
+        if flight is not None:
+            sealed, tail = read_ledger(flight_dir)
+            for entry in sealed + tail:
+                rows = entry.get("window_rows")
+                if rows is not None and int(rows) > flight["global_row"]:
+                    verdicts.append(
+                        {"action": entry.get("action"),
+                         "verdict": entry.get("verdict"),
+                         "candidate": entry.get("candidate"),
+                         "window_rows": int(rows),
+                         "sealed": entry in sealed})
+    return {"req_id": req_id, "stages": stages, "flight": flight,
+            "verdicts": verdicts,
+            "found": bool(stages or flight is not None)}
+
+
+def format_request_report(rep: dict) -> str:
+    """The human single-request timeline."""
+    rid = rep["req_id"]
+    lines = [f"request 0x{rid:016x} ({rid}):"]
+    if not rep["found"]:
+        lines.append("  not found: no serve instant, flight-log row, or "
+                     "ledger verdict carries this id")
+        return "\n".join(lines)
+    t0 = min((s["mono"] for s in rep["stages"]
+              if s.get("mono") is not None), default=0.0)
+    for s in rep["stages"]:
+        t = (s["mono"] - t0) if s.get("mono") is not None else 0.0
+        detail = " ".join(
+            f"{k}={v}" for k, v in sorted(s.items())
+            if k not in ("stage", "mono", "rank") and v is not None)
+        lines.append(f"  +{t:9.3f}s  rank {s.get('rank', '?'):>3}  "
+                     f"{s['stage']:<16s} {detail}")
+    if rep["flight"] is not None:
+        f = rep["flight"]
+        lines.append(
+            f"  logged: shard {f['shard_seq']:06d} row {f['row']} "
+            f"(global row {f['global_row']}, outcome "
+            f"{f['outcome_name']}) — {f['path']}")
+    elif not rep["verdicts"]:
+        lines.append("  logged: no flight-log row (shed, failed, "
+                     "unsealed tail, or no --flight-log given)")
+    for v in rep["verdicts"]:
+        seal = "sealed" if v["sealed"] else "unsealed tail"
+        lines.append(
+            f"  replayed: ledger {v['action']} "
+            f"(verdict={v['verdict']}, candidate={v['candidate']}, "
+            f"window={v['window_rows']} rows, {seal})")
+    if rep["flight"] is not None and not rep["verdicts"]:
+        lines.append("  replayed: no canary window covered this row yet")
+    return "\n".join(lines)
 
 
 def _fmt_history_line(e: dict, t0: float) -> str:
@@ -180,6 +325,16 @@ def format_report(rep: dict) -> str:
                 f"{(f'{sps:.1f}' if sps is not None else '?'):>12s} "
                 f"{(f'{wall:.4f}' if wall is not None else '?'):>12s}")
         lines.append("")
+    if rep.get("fleet"):
+        by_kind = {}
+        for e in rep["fleet"]:
+            k = str(e.get("kind"))
+            by_kind[k] = by_kind.get(k, 0) + 1
+        summary = ", ".join(f"{k}={n}" for k, n in sorted(by_kind.items()))
+        lines.append(f"flywheel & fleet health ({summary}):")
+        for e in rep["fleet"]:
+            lines.append(_fmt_history_line(e, rep["t0_mono"]))
+        lines.append("")
     if rep.get("chaos"):
         lines.append("chaos story (env_fault events, evaluate --chaos):")
         lines.append(f"  {'regime':<12s} {'scheduler':<10s} "
@@ -227,6 +382,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--strict-alarms", action="store_true",
                    help="exit 1 if any post-warmup alarm event "
                         f"({'/'.join(ALARM_KINDS)}) fired")
+    p.add_argument("--request", default=None, metavar="ID",
+                   help="print the single-request timeline for this "
+                        "64-bit request id (decimal or 0x-hex) instead "
+                        "of the run post-mortem; exit 1 if the id "
+                        "appears nowhere")
+    p.add_argument("--flight-log", default=None, metavar="DIR",
+                   help="with --request: also join the id against this "
+                        "flight-log directory's shards and promotion "
+                        "ledger")
     args = p.parse_args(argv)
     try:
         events = merge_dir(args.obs_dir)
@@ -240,6 +404,20 @@ def main(argv: list[str] | None = None) -> int:
     skew_info: dict = {"applied": False}
     if not args.no_skew_correct:
         events, skew_info = correct_events(events)
+    if args.request is not None:
+        try:
+            req_id = int(args.request, 0)
+        except ValueError:
+            print(f"--request: {args.request!r} is not an integer id",
+                  file=sys.stderr)
+            return 2
+        req = build_request_report(events, req_id,
+                                   flight_dir=args.flight_log)
+        if args.json:
+            print(json.dumps(req, sort_keys=True))
+        else:
+            print(format_request_report(req))
+        return 0 if req["found"] else 1
     if args.out:
         with open(args.out, "w") as f:
             for e in events:

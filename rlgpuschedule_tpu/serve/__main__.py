@@ -500,7 +500,7 @@ def main(argv: "list[str] | None" = None) -> dict:
                       if args.deadline_ms is not None else None)
         if args.bench:
             server = PolicyServer(engine, registry=registry,
-                                  tracer=tracer,
+                                  tracer=tracer, bus=bus,
                                   adaptive_wait=args.adaptive_wait)
             report["bench"] = run_bench(engine, server, pool,
                                         rounds=args.rounds,
@@ -519,7 +519,7 @@ def main(argv: "list[str] | None" = None) -> dict:
             obs0, mask0 = pool[0]
             engine.warmup(obs0, mask0)   # every bucket pre-paid
             server = PolicyServer(engine, registry=registry,
-                                  tracer=tracer,
+                                  tracer=tracer, bus=bus,
                                   adaptive_wait=args.adaptive_wait,
                                   flight_log=flight_writer)
             advisor = None
@@ -565,7 +565,9 @@ def main(argv: "list[str] | None" = None) -> dict:
                     fe_handle.close()   # drain: also closes the server
                 else:
                     server.stop()
-            server.slo_snapshot()       # final gauge refresh
+            # no manual slo_snapshot() here: the registry collector
+            # hook refreshes the gauges at every collect/render — the
+            # metrics.prom write below scrapes fresh values (ISSUE 20)
             soak["post_warmup_recompiles"] = \
                 engine.post_warmup_recompiles
             report["soak"] = soak
@@ -574,11 +576,16 @@ def main(argv: "list[str] | None" = None) -> dict:
                 # exactly-once accounting: every dispatched row was
                 # logged, every shed row was not (shed requests never
                 # reach the engine, so they never reach the log)
+                # the frontend selfcheck (if it ran) served one more
+                # request through the same server after the soak loop
+                fe_rows = (1 if report.get("frontend", {})
+                           .get("decide_status") == 200 else 0)
                 fl = {"dir": os.path.abspath(args.flight_log),
                       "rows_logged": flight_writer.rows_logged,
-                      "served": soak["served"],
+                      "served": soak["served"] + fe_rows,
                       "conservation_ok":
-                          flight_writer.rows_logged == soak["served"]}
+                          flight_writer.rows_logged
+                          == soak["served"] + fe_rows}
                 report["flight_log"] = fl
                 print(f"flight log: {fl['rows_logged']} rows sealed "
                       f"under {fl['dir']}, conservation "

@@ -47,6 +47,8 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import itertools
+import os
 import random
 import threading
 import time
@@ -194,6 +196,7 @@ class ServeResult:
     """What a request's future resolves to."""
     action: Any            # per-request action pytree (numpy)
     latency_s: float       # submit -> result, queue wait included
+    req_id: int = 0        # request-causality id (ISSUE 20); 0 = unassigned
 
 
 class DeadlineSheddedError(RuntimeError):
@@ -209,11 +212,12 @@ class DeadlineSheddedError(RuntimeError):
     than time out quietly inside."""
 
     def __init__(self, reason: str, deadline_s: float, waited_s: float,
-                 predicted_wait_s: "float | None" = None):
+                 predicted_wait_s: "float | None" = None, req_id: int = 0):
         self.reason = reason
         self.deadline_s = float(deadline_s)
         self.waited_s = float(waited_s)
         self.predicted_wait_s = predicted_wait_s
+        self.req_id = int(req_id)   # causality id, echoed on shed replies
         pred = (f", predicted wait {predicted_wait_s * 1e3:.1f}ms"
                 if predicted_wait_s is not None else "")
         super().__init__(
@@ -275,6 +279,7 @@ class _Pending:
     t_submit: float
     future: Future
     deadline_s: "float | None" = None   # relative to t_submit; None = no SLO
+    req_id: int = 0                     # request-causality id (ISSUE 20)
 
 
 class _SlotRef:
@@ -296,8 +301,9 @@ class _ArenaBlock:
     GIL-atomic list store, no lock — only after slot ``i``'s rows and
     metadata are fully written, so a consumer never reads a torn row."""
 
-    __slots__ = ("obs", "mask", "stall", "futures", "t_submit", "deadline",
-                 "published", "dead", "claimed", "n_dead", "n_deadlined")
+    __slots__ = ("obs", "mask", "stall", "req", "futures", "t_submit",
+                 "deadline", "published", "dead", "claimed", "n_dead",
+                 "n_deadlined")
 
     def __init__(self, obs_leaves, mask_leaves, capacity: int):
         self.obs = [np.zeros((capacity,) + l.shape, l.dtype)
@@ -305,6 +311,11 @@ class _ArenaBlock:
         self.mask = [np.zeros((capacity,) + l.shape, l.dtype)
                      for l in mask_leaves]
         self.stall = np.zeros(capacity, np.int32)
+        # request-causality sidecar lane (ISSUE 20): the 64-bit request
+        # id rides the slab next to the row it describes, so dispatch/
+        # scatter/flight-log all read it as one more preallocated
+        # column — zero per-request allocations, like the stall lane
+        self.req = np.zeros(capacity, np.int64)
         self.futures: "list[Future | None]" = [None] * capacity
         self.t_submit = [0.0] * capacity
         self.deadline: "list[float | None]" = [None] * capacity
@@ -359,9 +370,10 @@ class _ArenaRing:
         blk = _ArenaBlock(self._obs_leaves, self._mask_leaves, self.bucket)
         self.n_blocks += 1
         if self._alloc_counter is not None:
-            # slabs + the stall lane; metadata lists are not ndarrays
+            # slabs + the stall and req-id lanes; metadata lists are
+            # not ndarrays
             self._alloc_counter.inc(
-                len(self._obs_leaves) + len(self._mask_leaves) + 1)
+                len(self._obs_leaves) + len(self._mask_leaves) + 2)
         return blk
 
     def grow(self, n_blocks: int) -> None:
@@ -484,7 +496,22 @@ class PolicyServer:
     :meth:`slo_snapshot`, and ``serve_arena_allocs_total`` (host
     ndarrays allocated by the arena — warmup/ring-growth only; a moving
     value in steady state is a regression and the ci.sh host-path stage
-    gates on it).
+    gates on it). Since ISSUE 20 the percentile/throughput gauges are
+    refreshed by a registry pre-scrape collector hook (scrapes are
+    never stale), ``serve_queue_wait_seconds`` buckets the
+    submit->dispatch wait separately from service time, and
+    ``self.slo`` is an :class:`~..obs.slo.SLOEngine` evaluating
+    availability / queue-latency / engine-health burn rates
+    (``slo_burn_rate``, ``slo_error_budget_remaining``,
+    ``slo_burn_alert`` bus events) on every collect.
+
+    **Request causality** (ISSUE 20): every submit carries a 64-bit
+    ``req_id`` (caller-supplied or minted here) that rides an int64
+    sidecar lane of the arena slab — same zero-steady-state-allocation
+    contract as the data lanes — and is stamped on the
+    enqueue/shed/served instants, the latency exemplar reservoir, the
+    flight log's ``req_id`` column, and the resolved
+    :class:`ServeResult`.
 
     With a ``tracer`` attached (``serve --trace-spans``) the request
     lifecycle lands on the flight recorder: an ``enqueue`` instant per
@@ -504,8 +531,10 @@ class PolicyServer:
                  tracer=None, sample_seed: int = 0,
                  adaptive_wait: bool = False, data_plane: str = "arena",
                  example_obs: Any = None, example_mask: Any = None,
-                 arena_blocks: "int | None" = None, flight_log=None):
+                 arena_blocks: "int | None" = None, flight_log=None,
+                 bus=None):
         from ..obs import Registry
+        from ..obs.slo import SLOEngine, SLOSpec, histogram_sli
         self.engine = engine
         # data-flywheel tap: a capture-mode engine returns
         # (actions, behavior log-prob, value) per dispatch; the server
@@ -522,6 +551,16 @@ class PolicyServer:
                 "program, never a post-hoc recompute")
         self.registry = registry if registry is not None else Registry()
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.bus = bus
+        # request-causality ids (ISSUE 20): 64 bits = [1 zero bit]
+        # [7 rank][16 pid][40 seq] — collision-free across ranks and
+        # processes without coordination, and the sign bit stays clear
+        # so an id survives the int64 flight-log column round trip.
+        # seq starts at 1: id 0 means "unassigned" (v1 wire frames).
+        rank = int(getattr(bus, "rank", 0) or 0)
+        self._req_salt = (((rank & 0x7F) << 56)
+                          | ((os.getpid() & 0xFFFF) << 40))
+        self._req_seq = itertools.count(1)
         if max_wait_s is not None and max_wait_s < 0:
             raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
         if data_plane not in _DATA_PLANES:
@@ -549,6 +588,12 @@ class PolicyServer:
         # describe the whole run, not its trailing window
         self._latencies = Reservoir(latency_window, seed=sample_seed)
         self._occupancies = Reservoir(latency_window, seed=sample_seed + 1)
+        # exemplar lane: same capacity AND seed as _latencies, appended
+        # in lockstep -> Algorithm R draws identical replacement
+        # indices, so sample i's request id is _latency_req_ids[i] —
+        # ids can't ride float gauges (the salt exceeds 2**53), so the
+        # p99 exemplar surfaces through slo_snapshot()'s dict instead
+        self._latency_req_ids = Reservoir(latency_window, seed=sample_seed)
         self._threads: list[threading.Thread] = []
         self._stopped = False
         self._closed = False
@@ -585,6 +630,11 @@ class PolicyServer:
             "submit->result decision latency (cumulative histogram; "
             "aggregatable across ranks/restarts, unlike percentile "
             "gauges)")
+        self._queue_wait_hist = self.registry.histogram(
+            "serve_queue_wait_seconds",
+            "submit->dispatch queue wait (the shed-or-scale half of "
+            "decision latency: service time is the other half, and "
+            "only the split says which knob to turn)")
         self._dispatch_errors = self.registry.counter(
             "serve_dispatch_errors_total",
             "background pumps that raised after resolving their batch's "
@@ -602,6 +652,41 @@ class PolicyServer:
         add_listener = getattr(engine, "add_rewarm_listener", None)
         if callable(add_listener):
             add_listener(self._on_engine_rewarm)
+        # the hedge counter is the router's, shared through the common
+        # registry (re-registration returns the same object); over a
+        # plain engine it simply never moves
+        self._hedges = self.registry.counter(
+            "serve_retry_hedges_total",
+            "dispatches retried on a sibling engine after a failure")
+        # declarative SLOs (ISSUE 20): burn rates re-evaluated by the
+        # registry's pre-scrape collector hook, never hand-refreshed.
+        # Windows are soak-scale (seconds, not SRE-handbook hours)
+        # because this process's serving lifetime IS the soak.
+        self.slo = SLOEngine(self.registry, bus=bus)
+        self.slo.watch(SLOSpec(
+            "availability", objective=0.99,
+            windows=((5.0, 2.0), (30.0, 1.0)), budget_window_s=30.0,
+            description="fraction of admitted requests neither shed "
+                        "nor failed"), self._availability_sli)
+        self.slo.watch(SLOSpec(
+            "queue-latency", objective=0.95,
+            windows=((5.0, 2.0), (30.0, 1.0)), budget_window_s=30.0,
+            description="fraction of requests dispatched within 250ms "
+                        "of submit"),
+            histogram_sli(self._queue_wait_hist, 0.25))
+        # short windows + a rolling 3s budget: a hedge burst (a sick
+        # engine) trips the alert within one collect and the budget
+        # gauge visibly recovers seconds after the fault clears — the
+        # chaos-soak CI gate pins exactly that cycle
+        self.slo.watch(SLOSpec(
+            "engine-health", objective=0.999,
+            windows=((1.0, 1.0), (3.0, 1.0)), budget_window_s=3.0,
+            description="fraction of dispatches served without a "
+                        "hedge or failure"), self._engine_health_sli)
+        # the percentile/throughput gauges ride the same hook, retiring
+        # the manual slo_snapshot() refresh calls (a scrape between
+        # refreshes used to read stale gauges)
+        self.registry.add_collector(self._refresh_slo_gauges)
 
     # ---- estimator lifecycle -----------------------------------------
 
@@ -612,6 +697,42 @@ class PolicyServer:
         and the frontend's Retry-After falls back to its floor)."""
         with self._lock:
             self._service_time.reset()
+
+    # ---- request-causality ids ---------------------------------------
+
+    def mint_request_id(self) -> int:
+        """Next request-causality id. Thread-safe without a lock:
+        ``itertools.count.__next__`` is atomic under the GIL, and the
+        rank/pid salt makes ids from different processes disjoint. The
+        frontend calls this when a client didn't supply an
+        ``X-Request-Id`` (or sent a v1 frame), so it knows the id it
+        must echo on the response."""
+        return self._req_salt | (next(self._req_seq) & 0xFFFFFFFFFF)
+
+    # ---- SLI plumbing ------------------------------------------------
+
+    def _availability_sli(self) -> "tuple[float, float]":
+        """(bad, total) for the availability SLO: bad = typed sheds
+        plus failed dispatches (a failed pump fails every row it
+        carried; counting it once is the cheap conservative floor),
+        total = requests admitted at the door."""
+        return (self._shed.value + self._dispatch_errors.value,
+                self._requests.value)
+
+    def _engine_health_sli(self) -> "tuple[float, float]":
+        """(bad, total) for the engine-health SLO: bad = retry hedges
+        (each one is a dispatch an engine failed before the hedge
+        rescued it) plus dispatches that failed outright, total =
+        dispatches attempted."""
+        return (self._hedges.value + self._dispatch_errors.value,
+                self._dispatches.value + self._dispatch_errors.value)
+
+    def _refresh_slo_gauges(self) -> None:
+        """Pre-scrape collector hook: recompute the percentile and
+        throughput gauges at render time — the replacement for the
+        manual ``slo_snapshot()`` refresh calls the CLIs used to
+        sprinkle before every write."""
+        self.slo_snapshot()
 
     # ---- arena construction ------------------------------------------
 
@@ -676,15 +797,25 @@ class PolicyServer:
             return
         with self._shed_lock:
             self._shed.inc()
-        self.tracer.instant("shed", reason=reason)
+        self.tracer.instant("shed", reason=reason, req_id=exc.req_id)
 
     # ---- submit ------------------------------------------------------
 
     def submit(self, obs: Any, mask: Any, stall: int = 0,
-               deadline_s: "float | None" = None) -> Future:
+               deadline_s: "float | None" = None,
+               req_id: "int | None" = None) -> Future:
         """Enqueue one scheduling request (host pytrees, NO leading batch
         axis). ``stall`` is the client's consecutive-zero-dt count for
         the stall gate (preemptive configs; 0 = gate disengaged).
+
+        ``req_id`` is the request-causality key (ISSUE 20): minted here
+        when the caller didn't bring one (``None``/0 — the frontend
+        mints eagerly instead, so it can echo the id even on a shed).
+        The id rides the arena sidecar lane through dispatch and
+        scatter, is stamped on the enqueue/shed/served instants and the
+        latency exemplars, lands in the flight log's ``req_id`` column,
+        and comes back on the resolved :class:`ServeResult` — one key
+        joining every observation of this request's life.
 
         ``deadline_s`` is the request's latency SLO, relative to submit.
         A deadlined request is subject to **load shedding**: if the
@@ -701,17 +832,21 @@ class PolicyServer:
         (wire bytes -> arena when called from the frontend's
         ``np.frombuffer`` views). Rows that don't match the arena's
         fixed shapes raise ``ValueError`` here, at the door."""
+        req_id = self.mint_request_id() if not req_id else int(req_id)
         if self.data_plane == "legacy":
-            return self._submit_legacy(obs, mask, stall, deadline_s)
-        return self._submit_arena(obs, mask, stall, deadline_s)
+            return self._submit_legacy(obs, mask, stall, deadline_s,
+                                       req_id)
+        return self._submit_arena(obs, mask, stall, deadline_s, req_id)
 
-    def _submit_legacy(self, obs, mask, stall, deadline_s) -> Future:
+    def _submit_legacy(self, obs, mask, stall, deadline_s,
+                       req_id) -> Future:
         now = self._clock()
         fut: Future = Future()
         req = _Pending(obs=obs, mask=mask, stall=int(stall),
                        t_submit=now, future=fut,
                        deadline_s=(None if deadline_s is None
-                                   else float(deadline_s)))
+                                   else float(deadline_s)),
+                       req_id=req_id)
         with self._wake:
             if self._closed:
                 raise ServerClosedError(
@@ -733,11 +868,12 @@ class PolicyServer:
                 if predicted > req.deadline_s:
                     self._reject(fut, DeadlineSheddedError(
                         "admission", req.deadline_s, waited_s=0.0,
-                        predicted_wait_s=predicted), reason="admission")
+                        predicted_wait_s=predicted, req_id=req_id),
+                        reason="admission")
                     return fut
             self._pending.append(req)
             self._wake.notify()
-        self.tracer.instant("enqueue", stall=int(stall))
+        self.tracer.instant("enqueue", stall=int(stall), req_id=req_id)
         return fut
 
     def _write_row(self, blk: _ArenaBlock, i: int, obs, mask,
@@ -776,7 +912,8 @@ class PolicyServer:
             blk.mask[j][i] = leaf
         blk.stall[i] = stall
 
-    def _submit_arena(self, obs, mask, stall, deadline_s) -> Future:
+    def _submit_arena(self, obs, mask, stall, deadline_s,
+                      req_id) -> Future:
         if self._ring is None:
             self.ensure_arena(obs, mask)     # lazy sizing, first request
         ring = self._ring
@@ -804,7 +941,7 @@ class PolicyServer:
                 if predicted > deadline_s:
                     shed_exc = DeadlineSheddedError(
                         "admission", deadline_s, waited_s=0.0,
-                        predicted_wait_s=predicted)
+                        predicted_wait_s=predicted, req_id=req_id)
             if shed_exc is None:
                 # common case inlined: current block has a free slot
                 blk = ring.cur
@@ -840,6 +977,7 @@ class PolicyServer:
                 ring.depth -= 1
             blk.published[i] = True
             raise
+        blk.req[i] = req_id          # sidecar lane: one int64 store
         blk.t_submit[i] = now
         blk.deadline[i] = deadline_s
         blk.futures[i] = fut
@@ -850,7 +988,8 @@ class PolicyServer:
             with self._wake:         # steady state: dispatchers stay hot)
                 self._wake.notify_all()
         if self.tracer is not NULL_TRACER:
-            self.tracer.instant("enqueue", stall=int(stall))
+            self.tracer.instant("enqueue", stall=int(stall),
+                                req_id=req_id)
         return fut
 
     def _reserve_slot_locked(self, ring: _ArenaRing):
@@ -898,7 +1037,8 @@ class PolicyServer:
                     and now - r.t_submit > r.deadline_s):
                 self._reject(r.future, DeadlineSheddedError(
                     "expired", r.deadline_s,
-                    waited_s=now - r.t_submit), reason="expired")
+                    waited_s=now - r.t_submit,
+                    req_id=r.req_id), reason="expired")
             else:
                 keep.append(r)
         self._pending = keep
@@ -912,7 +1052,7 @@ class PolicyServer:
         ring = self._ring
         if ring is None:
             return
-        expired: "list[tuple[Future, float, float]]" = []
+        expired: "list[tuple[Future, float, float, int]]" = []
         with ring.lock:
             blocks = ring.blocks()
             if not any(b.n_deadlined for b in blocks):
@@ -930,11 +1070,13 @@ class PolicyServer:
                         blk.n_dead += 1
                         blk.n_deadlined -= 1
                         ring.depth -= 1
-                        expired.append((blk.futures[i], d, waited))
+                        expired.append((blk.futures[i], d, waited,
+                                        int(blk.req[i])))
                         blk.futures[i] = None
-        for fut, d, waited in expired:
+        for fut, d, waited, rid in expired:
             self._reject(fut, DeadlineSheddedError(
-                "expired", d, waited_s=waited), reason="expired")
+                "expired", d, waited_s=waited, req_id=rid),
+                reason="expired")
 
     # ---- adaptive hold -----------------------------------------------
 
@@ -1038,7 +1180,7 @@ class PolicyServer:
         return out, None, None
 
     def _log_rows(self, obs, mask, stall, actions, blp, bval, n: int,
-                  lats: "list[float]", deads) -> None:
+                  lats: "list[float]", deads, req_ids) -> None:
         """Append this dispatch's ``n`` SERVED rows to the flight log.
         Deadline outcome per row: 0 = no deadline, 1 = met, 2 = served
         late (resolved past its SLO but not shed). Shed rows never reach
@@ -1059,7 +1201,8 @@ class PolicyServer:
             jax.tree.map(lambda l: np.asarray(l)[:n], mask),
             jax.tree.map(lambda l: np.asarray(l)[:n], actions),
             np.asarray(blp)[:n], np.asarray(bval)[:n],
-            np.asarray(stall)[:n], outcome)
+            np.asarray(stall)[:n], outcome,
+            req_id=np.asarray(req_ids, np.int64)[:n])
 
     def _pump_legacy(self, max_wait_s: "float | None") -> int:
         with self._lock:
@@ -1077,6 +1220,7 @@ class PolicyServer:
         if not batch:
             return 0
         n = len(batch)
+        rids = [r.req_id for r in batch]
         t_disp = self._clock()
         try:
             with self.tracer.span("serve_batch", n=n):
@@ -1096,17 +1240,26 @@ class PolicyServer:
                 # its batch's futures — a failing flight-log append must
                 # fail the batch loudly, never strand it
                 self._log_rows(obs, mask, stall, actions, blp, bval, n,
-                               lats, [r.deadline_s for r in batch])
+                               lats, [r.deadline_s for r in batch],
+                               rids)
         except BaseException as e:
             for r in batch:
                 if not r.future.cancelled():
                     r.future.set_exception(e)
+            if self.tracer is not NULL_TRACER:
+                self.tracer.instant("dispatch_failed", req_ids=rids,
+                                    error=type(e).__name__)
             raise
-        self._account_dispatch(
-            now, t_disp, n, bucket, lats,
-            t_first=min(r.t_submit for r in batch))
+        t_subs = [r.t_submit for r in batch]
+        self._account_dispatch(now, t_disp, n, bucket, lats, t_subs, rids)
         for r, a, lat in zip(batch, per_req, lats):
-            r.future.set_result(ServeResult(action=a, latency_s=lat))
+            r.future.set_result(ServeResult(action=a, latency_s=lat,
+                                            req_id=r.req_id))
+        if self.tracer is not NULL_TRACER:
+            self.tracer.instant(
+                "served", bucket=bucket, req_ids=rids,
+                wait_ms=[round((t_disp - t) * 1e3, 3) for t in t_subs],
+                lat_ms=[round(l * 1e3, 3) for l in lats])
         return n
 
     def _seal_block(self, blk: _ArenaBlock):
@@ -1115,8 +1268,10 @@ class PolicyServer:
         producer published its reservation before we took the block),
         compact live rows over dead ones (shed slots become padding),
         and neutralize the pad tail IN PLACE (zero obs, all-legal bool
-        masks, zero stall) — pure slice assignment, no allocation.
-        Returns ``(n_live, bucket, futures, t_submits, deadlines)``."""
+        masks, zero stall, zero req id) — pure slice assignment, no
+        allocation. Returns ``(n_live, bucket, futures, t_submits,
+        deadlines, req_ids)`` — ``req_ids`` is a view into the slab's
+        sidecar lane, valid until the block recycles."""
         spin_deadline = time.monotonic() + 5.0
         while not all(blk.published[:blk.claimed]):
             if time.monotonic() > spin_deadline:
@@ -1132,7 +1287,7 @@ class PolicyServer:
         live = [i for i in range(blk.claimed) if not blk.dead[i]]
         n_live = len(live)
         if n_live == 0:
-            return 0, 0, [], [], []
+            return 0, 0, [], [], [], []
         if n_live != blk.claimed:
             # compact: shift live rows down over dead ones (dst <= src,
             # so in-place row moves are safe); rare — shed path only
@@ -1144,6 +1299,7 @@ class PolicyServer:
                 for leaf in blk.mask:
                     leaf[dst] = leaf[src]
                 blk.stall[dst] = blk.stall[src]
+                blk.req[dst] = blk.req[src]
                 blk.futures[dst] = blk.futures[src]
                 blk.t_submit[dst] = blk.t_submit[src]
                 blk.deadline[dst] = blk.deadline[src]
@@ -1155,8 +1311,10 @@ class PolicyServer:
                 leaf[n_live:bucket] = (True if leaf.dtype == np.bool_
                                        else 0)
             blk.stall[n_live:bucket] = 0
+            blk.req[n_live:bucket] = 0
         return (n_live, bucket, blk.futures[:n_live],
-                blk.t_submit[:n_live], blk.deadline[:n_live])
+                blk.t_submit[:n_live], blk.deadline[:n_live],
+                blk.req[:n_live])
 
     def _arena_views(self, blk: _ArenaBlock, bucket: int):
         """Contiguous ``[:bucket]`` views of the slab, re-assembled into
@@ -1213,7 +1371,8 @@ class PolicyServer:
             return 0
         t_disp = self._clock()
         try:
-            n_live, bucket, futs, t_subs, deads = self._seal_block(blk)
+            n_live, bucket, futs, t_subs, deads, rids = \
+                self._seal_block(blk)
         except BaseException:
             ring.recycle(blk)
             raise
@@ -1246,26 +1405,39 @@ class PolicyServer:
                 # futures with the exception (the dispatcher loop's
                 # no-silent-drop invariant), never strand them
                 self._log_rows(obs, mask, stall, actions, blp, bval,
-                               n_live, lats, deads)
+                               n_live, lats, deads, rids)
         except BaseException as e:
             for fut in futs:
                 if not fut.cancelled():
                     fut.set_exception(e)
+            if self.tracer is not NULL_TRACER:
+                self.tracer.instant("dispatch_failed",
+                                    req_ids=[int(r) for r in rids],
+                                    error=type(e).__name__)
             ring.recycle(blk)
             raise
         self._account_dispatch(now, t_disp, n_live, bucket, lats,
-                               t_first=min(t_subs))
-        for fut, a, lat in zip(futs, per_req, lats):
+                               t_subs, rids)
+        for fut, a, lat, rid in zip(futs, per_req, lats, rids):
             try:
-                fut.set_result(ServeResult(action=a, latency_s=lat))
+                fut.set_result(ServeResult(action=a, latency_s=lat,
+                                           req_id=int(rid)))
             except BaseException:   # cancelled while in flight
                 pass
+        if self.tracer is not NULL_TRACER:
+            # one instant per DISPATCH, not per request: the causality
+            # record for n_live requests costs one bus write
+            self.tracer.instant(
+                "served", bucket=bucket,
+                req_ids=[int(r) for r in rids],
+                wait_ms=[round((t_disp - t) * 1e3, 3) for t in t_subs],
+                lat_ms=[round(l * 1e3, 3) for l in lats])
         ring.recycle(blk)
         return n_live
 
     def _account_dispatch(self, now: float, t_disp: float, n: int,
                           bucket: int, lats: "list[float]",
-                          t_first: float) -> None:
+                          t_subs, req_ids) -> None:
         """Per-dispatch accounting under the consumer lock: concurrent
         dispatcher threads (start(dispatchers=N) over a router) share
         every reservoir, counter, and estimator below. Producers never
@@ -1277,13 +1449,15 @@ class PolicyServer:
             self._occupancy.set(n / bucket)
             self._occupancies.append(n / bucket)
             if self._t_first is None:
-                self._t_first = t_first
+                self._t_first = min(t_subs)
             self._t_last = now if self._t_last is None else max(
                 self._t_last, now)
             self._served += n
-            for lat in lats:
+            for lat, t_sub, rid in zip(lats, t_subs, req_ids):
                 self._latencies.append(lat)
+                self._latency_req_ids.append(int(rid))   # exemplar lane
                 self._latency_hist.observe(lat)
+                self._queue_wait_hist.observe(max(t_disp - t_sub, 0.0))
             self._sample_window.set(len(self._latencies))
 
     # ---- live dispatcher thread --------------------------------------
@@ -1381,6 +1555,12 @@ class PolicyServer:
                     break
             except Exception:
                 self._dispatch_errors.inc()
+        # one final refresh, then detach from the scrape surface: a
+        # scrape after close reads the last computed SLO values instead
+        # of running collectors against a dead server
+        self.registry.collect()
+        self.registry.remove_collector(self._refresh_slo_gauges)
+        self.slo.close()
 
     @property
     def closed(self) -> bool:
@@ -1429,7 +1609,16 @@ class PolicyServer:
             "batch_occupancy_mean": (float(np.mean(self._occupancies))
                                      if self._occupancies else None),
             "serving_span_s": span,
+            "slo": self.slo.status(),
         }
+        if lats.size and len(self._latency_req_ids) == lats.size:
+            # exemplar: the request id of the sample nearest the p99 —
+            # the concrete request a p99 regression points at (ids
+            # exceed a float gauge's 2**53 precision, so the exemplar
+            # only rides this dict, never the registry)
+            p99 = float(np.percentile(lats, 99))
+            snap["latency_p99_exemplar_req_id"] = int(
+                self._latency_req_ids[int(np.argmin(np.abs(lats - p99)))])
         if lats.size:
             self.registry.gauge(
                 "serve_decision_latency_p50_ms",

@@ -113,6 +113,8 @@ def run_bench(engine, server, pool: "list[tuple[Any, Any]]",
         server.pump()
     results = [f.result(timeout=60) for f in futures]
 
+    # a DATA site, not a gauge refresh: the snapshot dict is the bench
+    # report (gauge freshness is the registry collector hook's job now)
     snap = server.slo_snapshot()
     return {
         "rounds": rounds,
@@ -215,8 +217,9 @@ def run_soak(server, pool: "list[tuple[Any, Any]]", *,
     """Sustained-load soak through a RUNNING server (caller started the
     dispatchers): pace submissions at ``rate_hz`` for ``duration_s``,
     optionally attaching a per-request ``deadline_s`` (shedding active)
-    and an autoscale loop (every ``advisor_every_s``: refresh the SLO
-    gauges, let ``advisor`` vote, apply to ``router``). Reports
+    and an autoscale loop (every ``advisor_every_s``: let ``advisor``
+    vote — its tick refreshes the SLO gauges through the registry
+    collector hook — and apply to ``router``). Reports
     first-half vs second-half p99 — the drift surface the soak-lite CI
     stage bounds (an unbounded queue or a leak shows up as second-half
     p99 runaway)."""
@@ -238,7 +241,6 @@ def run_soak(server, pool: "list[tuple[Any, Any]]", *,
         cursor += 1
         now = time.perf_counter()
         if advisor is not None and now >= next_tick:
-            server.slo_snapshot()       # refresh the gauges it reads
             before = advisor.desired
             router.apply_autoscale(advisor)
             resizes += int(advisor.desired != before)
@@ -422,7 +424,7 @@ def _run_wire_arm(pool: "list[tuple[Any, Any]]", *, bucket: int,
                     barrier.wait()
                 for _ in range(n):
                     s.sendall(frame)
-                    kind, _, _, _, _ = wire.recv_frame(s)
+                    kind, _, _, _, _, _ = wire.recv_frame(s)
                     if phase == "measure" and kind == wire.KIND_RESP:
                         ok[k] += 1
 
@@ -657,22 +659,38 @@ def run_chaos_soak(server, pool: "list[tuple[Any, Any]]", *, fit,
     plus the exactly-once counter cross-check (``registry_shed_total``
     must equal the shed futures actually observed) and the router's
     ejection/readmission/hedge story (:meth:`~.router.EngineRouter.
-    fault_stats`)."""
+    fault_stats`).
+
+    The pacing loop calls ``registry.collect()`` twice a second, so the
+    SLO engine's burn windows advance DURING the fault window (a burn
+    alert must fire while the bleeding happens, not at the post-mortem
+    scrape), and after the last future resolves the soak keeps
+    collecting until every SLO stops alerting (bounded) — the report's
+    ``slo`` section shows the recovered budget."""
     from .batching import DeadlineSheddedError
 
     n_gaps = max(int(duration_s * rate_hz * 2) + 16, 1)
     gaps = fit_paced_gaps(fit, n_gaps, seed=(seed, 0xC7A05),
                           rate_hz=rate_hz)
+    reg = server.registry
     rss_start = _rss_bytes()
     futures = []
     cursor = 0
     t_start = time.perf_counter()
     next_t = t_start
+    # pre-incident baseline sample: burn is measured between samples,
+    # so a fault that fires before the FIRST collect would be invisible
+    # (baked into the initial cumulative reading) without this
+    reg.collect()
+    next_collect = t_start + 0.5
     while time.perf_counter() - t_start < duration_s:
         obs, mask = pool[cursor % len(pool)]
         futures.append(server.submit(obs, mask, deadline_s=deadline_s))
         next_t += gaps[cursor % len(gaps)]
         cursor += 1
+        if time.perf_counter() >= next_collect:
+            reg.collect()
+            next_collect += 0.5
         sleep = next_t - time.perf_counter()
         if sleep > 0:
             time.sleep(sleep)
@@ -694,6 +712,29 @@ def run_chaos_soak(server, pool: "list[tuple[Any, Any]]", *, fit,
     wall = time.perf_counter() - t_start
     served = len(futures) - shed - failed
 
+    # settle: keep the burn windows sliding until every SLO clears (the
+    # 1s engine-health window un-trips ~1s after the last hedge, the 3s
+    # budget window recovers shortly after), bounded so a genuinely
+    # still-burning SLO reports alerting=True instead of hanging
+    slo_status: dict = {}
+    if getattr(server, "slo", None) is not None:
+        settle_by = time.perf_counter() + 4.0
+        while True:
+            reg.collect()
+            slo_status = server.slo.status()
+            settled = not any(s["alerting"] for s in slo_status.values())
+            # ...and let SHORT budget windows slide fully past the
+            # incident, so the report shows the recovered budget rather
+            # than the mid-bleed snapshot (long windows would outlast
+            # the settle bound — leave those to the dashboards)
+            settled = settled and all(
+                s["budget_remaining"] >= 1.0
+                for s in slo_status.values()
+                if s["alerts_total"] and s["budget_window_s"] <= 3.0)
+            if settled or time.perf_counter() >= settle_by:
+                break
+            time.sleep(0.2)
+
     def p99_ms(xs):
         xs = [x for x in xs if x is not None]
         return (float(np.percentile(np.asarray(xs), 99) * 1e3)
@@ -701,7 +742,6 @@ def run_chaos_soak(server, pool: "list[tuple[Any, Any]]", *, fit,
 
     half = len(lat_s) // 2
     p99_a, p99_b = p99_ms(lat_s[:half]), p99_ms(lat_s[half:])
-    reg = server.registry
     out = {
         "requests": len(futures),
         "served": served,
@@ -721,6 +761,7 @@ def run_chaos_soak(server, pool: "list[tuple[Any, Any]]", *, fit,
         "p99_second_half_ms": p99_b,
         "p99_drift": (p99_b / p99_a
                       if p99_a and p99_b and p99_a > 0 else None),
+        "slo": slo_status,
     }
     # heap-drift gate inputs: RSS before the first submit vs after the
     # last future resolved (all recycled slabs back in the ring)

@@ -34,10 +34,19 @@ the connection alive between requests (``Connection: close`` — from the
 client, or from the server on drain refusals — ends it), and the same
 port speaks a second, cheaper dialect: a connection whose first 4 bytes
 are :data:`~.wire.MAGIC` is **framed** for its whole life
-(:mod:`.wire` — 24-byte length-prefixed frames, descriptor validated
-by byte equality, no per-request parse). Either way ``np.frombuffer``
-stays the only decode, and the views point straight at the arena slot
-write inside ``submit`` — one copy, wire to slab.
+(:mod:`.wire` — length-prefixed v2 frames, 32-byte prefix, descriptor
+validated by byte equality, no per-request parse; legacy 24-byte v1
+frames still decode). Either way ``np.frombuffer`` stays the only
+decode, and the views point straight at the arena slot write inside
+``submit`` — one copy, wire to slab.
+
+Request causality (ISSUE 20): every decide carries a 64-bit request id
+— inbound via the ``X-Request-Id`` header (HTTP) or the v2 frame's
+``req_id`` field, minted by the server when absent — and every reply
+shape echoes it (the ``request_id`` JSON field / the response frame's
+``req_id``), including sheds, timeouts, and drain refusals. The id is
+the join key ``obs.report --request`` uses to reconstruct the request's
+full timeline across the bus, the flight log, and the canary ledger.
 
 The listener is stdlib-only (``asyncio.start_server`` + hand-rolled
 HTTP/1.1) on purpose: no new dependency, and the protocol surface is
@@ -413,19 +422,21 @@ class ServeFrontend:
         return min(max(retry, RETRY_AFTER_MIN_S), RETRY_AFTER_MAX_S)
 
     async def _decide(self, obs, mask, stall: int,
-                      deadline_s: "float | None"):
+                      deadline_s: "float | None", req_id: int = 0):
         """The transport-agnostic decide core: submit, await, classify.
         Returns ``(status, payload)`` where status is one of ``"ok"``
         (payload = :class:`~.batching.ServeResult`), ``"shed"``
         (payload = (exc, retry_after_s)), ``"closed"`` (payload = detail
-        str), ``"timeout"``."""
+        str), ``"timeout"``. ``req_id`` threads the causality key into
+        the server (0 = let ``submit`` mint one)."""
         assert self._idle is not None
         self._inflight += 1
         self._idle.clear()
         try:
             try:
                 fut = self.server.submit(obs, mask, stall=stall,
-                                         deadline_s=deadline_s)
+                                         deadline_s=deadline_s,
+                                         req_id=req_id)
             except ServerClosedError:
                 return "closed", "server is draining"
             try:
@@ -476,12 +487,24 @@ class ServeFrontend:
             stall = int(headers.get("x-stall", "0") or "0")
         except ValueError as e:
             raise _BadRequest("bad X-Stall") from e
+        req_id = 0
+        if "x-request-id" in headers:
+            try:
+                req_id = int(headers["x-request-id"], 0)
+            except ValueError as e:
+                raise _BadRequest("bad X-Request-Id") from e
+            if not 0 <= req_id < (1 << 63):
+                raise _BadRequest("X-Request-Id must be in [0, 2**63)")
+        if not req_id:
+            req_id = self.server.mint_request_id()
 
-        status, payload = await self._decide(obs, mask, stall, deadline_s)
+        status, payload = await self._decide(obs, mask, stall, deadline_s,
+                                             req_id)
         if status == "closed":
             self._http_closed.inc()
             return _response("503 Service Unavailable",
-                             {"error": "closed", "detail": payload},
+                             {"error": "closed", "detail": payload,
+                              "request_id": req_id},
                              close=True), True
         if status == "shed":
             exc, retry = payload
@@ -491,30 +514,38 @@ class ServeFrontend:
                 {"error": "shed", "reason": exc.reason,
                  "deadline_ms": exc.deadline_s * 1e3,
                  "waited_ms": exc.waited_s * 1e3,
-                 "retry_after_s": retry},
+                 "retry_after_s": retry,
+                 "request_id": req_id},
                 (f"Retry-After: {retry:.3f}",)), False
         if status == "timeout":
             return _response("504 Gateway Timeout",
                              {"error": "timeout",
-                              "timeout_s": self.request_timeout_s}), False
+                              "timeout_s": self.request_timeout_s,
+                              "request_id": req_id}), False
         result = payload
         import jax
         action = jax.tree.map(lambda x: np.asarray(x).tolist(),
                               result.action)
         return _response("200 OK",
                          {"action": action,
-                          "latency_ms": result.latency_s * 1e3}), False
+                          "latency_ms": result.latency_s * 1e3,
+                          "request_id": req_id}), False
 
     # ---- frame mode --------------------------------------------------
 
     async def _read_frame(self, reader: asyncio.StreamReader,
                           preread: bytes = b""):
+        # sniff the version byte: v1 prefixes are 24 bytes, v2 are 32
+        # (8 extra bytes of req_id) — same logic as wire.recv_frame
         head = preread + await reader.readexactly(
-            wire.PREFIX_SIZE - len(preread))
-        kind, hlen, blen, meta64, meta32 = wire.unpack_prefix(head)
+            wire.PREFIX_V1_SIZE - len(preread))
+        if head[4] == wire.VERSION:
+            head += await reader.readexactly(
+                wire.PREFIX_SIZE - wire.PREFIX_V1_SIZE)
+        kind, hlen, blen, meta64, meta32, req_id = wire.unpack_prefix(head)
         header = await reader.readexactly(hlen) if hlen else b""
         body = await reader.readexactly(blen) if blen else b""
-        return kind, header, body, meta64, meta32
+        return kind, header, body, meta64, meta32, req_id
 
     async def _serve_framed(self, reader: asyncio.StreamReader,
                             writer: asyncio.StreamWriter,
@@ -536,47 +567,60 @@ class ServeFrontend:
                 await writer.drain()
                 return      # framing is lost; the stream cannot resync
             preread = b""
-            kind, header, body, meta64, meta32 = frame
+            kind, header, body, meta64, meta32, req_id = frame
             resp, close = await self._handle_frame(kind, header, body,
-                                                   meta64, meta32)
+                                                   meta64, meta32, req_id)
             writer.write(resp)
             await writer.drain()
             if close:
                 return
 
     async def _handle_frame(self, kind: int, header: bytes, body: bytes,
-                            meta64: int, meta32: int):
+                            meta64: int, meta32: int, req_id: int = 0):
         if kind != wire.KIND_REQ:
             self._http_bad.inc()
             return wire.pack_error(
                 "bad-request",
-                {"detail": f"expected KIND_REQ, got {kind}"}), True
+                {"detail": f"expected KIND_REQ, got {kind}"},
+                req_id=req_id), True
+        if req_id >= (1 << 63):
+            # the wire field is uint64 but the causality lane is int64
+            # (flight-log column) — reject rather than truncate
+            self._http_bad.inc()
+            return wire.pack_error(
+                "bad-request",
+                {"detail": "req_id must be < 2**63"}), False
         self._http_requests.inc()
+        if not req_id:
+            req_id = self.server.mint_request_id()
         if self._draining:
             self._http_closed.inc()
             return wire.pack_error(
-                "closed", {"detail": "server is draining"}), True
+                "closed", {"detail": "server is draining"},
+                req_id=req_id), True
         if header != self._req_descriptor:
             self._http_bad.inc()
             return wire.pack_error(
                 "bad-request",
                 {"detail": f"descriptor mismatch: got {header!r}, "
                            f"serving {self._req_descriptor.decode()}"},
-            ), False
+                req_id=req_id), False
         expected = self._obs_nbytes + self._mask_nbytes
         if len(body) != expected:
             self._http_bad.inc()
             return wire.pack_error(
                 "bad-request",
                 {"detail": f"body must be exactly {expected} bytes, "
-                           f"got {len(body)}"}), False
+                           f"got {len(body)}"},
+                req_id=req_id), False
         obs, mask = self._parse_body(body)
         deadline_s = meta64 / 1e6 if meta64 else None
         status, payload = await self._decide(obs, mask, int(meta32),
-                                             deadline_s)
+                                             deadline_s, req_id)
         if status == "closed":
             self._http_closed.inc()
-            return wire.pack_error("closed", {"detail": payload}), True
+            return wire.pack_error("closed", {"detail": payload},
+                                   req_id=req_id), True
         if status == "shed":
             exc, retry = payload
             self._http_shed.inc()
@@ -585,13 +629,14 @@ class ServeFrontend:
                 {"deadline_ms": exc.deadline_s * 1e3,
                  "waited_ms": exc.waited_s * 1e3,
                  "retry_after_s": retry},
-                retry_after_s=retry), False
+                retry_after_s=retry, req_id=req_id), False
         if status == "timeout":
             return wire.pack_error(
-                "timeout", {"timeout_s": self.request_timeout_s}), False
+                "timeout", {"timeout_s": self.request_timeout_s},
+                req_id=req_id), False
         result = payload
         return wire.pack_response(np.asarray(result.action),
-                                  result.latency_s), False
+                                  result.latency_s, req_id=req_id), False
 
 
 class FrontendHandle:

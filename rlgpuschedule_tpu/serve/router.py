@@ -719,7 +719,10 @@ class AutoscaleAdvisor:
     def observe(self) -> int:
         """One advisory tick: fold the current SLO surface into the
         hysteresis streak; return the (possibly updated) desired engine
-        count."""
+        count. Runs the registry's pre-scrape collectors first, so the
+        gauges it votes on (and the SLO burn windows) are fresh at the
+        tick — callers no longer refresh by hand (ISSUE 20)."""
+        self.registry.collect()
         v = self._vote()
         if v == 0:
             self._streak = 0

@@ -5,10 +5,10 @@ clients that cost dominates the host path once the data plane itself is
 zero-copy (ISSUE 17). This module defines the **frame mode** the
 frontend speaks on the same port: a connection whose first 4 bytes are
 ``MAGIC`` is framed for its whole life, anything else is HTTP. One
-frame is::
+v2 frame is::
 
-    <4s B  B    H        I         Q        I  >   little-endian
-    magic ver kind  header_len  body_len  meta64  meta32
+    <4s B  B    H        I         Q        I       Q   >  little-endian
+    magic ver kind  header_len  body_len  meta64  meta32  req_id
     [header: header_len bytes][body: body_len bytes]
 
 - ``kind=KIND_REQ``: header is the request **descriptor** — an exact
@@ -26,10 +26,22 @@ frame is::
   suggested retry-after in microseconds (0 = do not retry here), body a
   small JSON detail payload mirroring the HTTP error shape.
 
-The framing is deliberately dumb: fixed 24-byte prefix, no
-continuation, no multiplexing — amortizing parse cost over a keep-alive
-connection is the whole win, and the protocol stays small enough to pin
-completely in tier-1 tests.
+``req_id`` (v2, ISSUE 20) is the request-causality key: a compact
+64-bit id the client may supply (0 = let the server mint one) that the
+server threads through the arena, the latency exemplars, the flight
+log, and every response/error frame for that request — the join key
+``obs.report --request`` reconstructs a timeline from.
+
+**Version compatibility**: ``VERSION`` is 2 and :func:`pack_frame`
+always emits the 32-byte v2 prefix, but v1 frames (24-byte prefix, no
+``req_id`` field) still decode — :func:`unpack_prefix` accepts both
+sizes and :func:`recv_frame` sniffs the version byte before reading the
+prefix tail. A v1 frame simply carries ``req_id == 0`` ("unassigned").
+
+The framing is deliberately dumb: fixed-size prefix, no continuation,
+no multiplexing — amortizing parse cost over a keep-alive connection is
+the whole win, and the protocol stays small enough to pin completely in
+tier-1 tests.
 """
 from __future__ import annotations
 
@@ -41,14 +53,16 @@ from typing import Any
 import numpy as np
 
 MAGIC = b"RLSF"
-VERSION = 1
+VERSION = 2
 KIND_REQ = 1
 KIND_RESP = 2
 KIND_ERR = 3
 _KINDS = (KIND_REQ, KIND_RESP, KIND_ERR)
 
-PREFIX = struct.Struct("<4sBBHIQI")
-PREFIX_SIZE = PREFIX.size            # 24 bytes
+PREFIX = struct.Struct("<4sBBHIQIQ")
+PREFIX_SIZE = PREFIX.size            # 32 bytes (v2)
+PREFIX_V1 = struct.Struct("<4sBBHIQI")
+PREFIX_V1_SIZE = PREFIX_V1.size      # 24 bytes (v1, no req_id)
 
 # defensive ceiling: a frame is one request/response row, never a
 # training batch — anything bigger is a corrupt or hostile prefix
@@ -71,7 +85,7 @@ def descriptor(tree: Any) -> bytes:
 
 
 def pack_frame(kind: int, header: bytes, body: bytes = b"",
-               meta64: int = 0, meta32: int = 0) -> bytes:
+               meta64: int = 0, meta32: int = 0, req_id: int = 0) -> bytes:
     if kind not in _KINDS:
         raise WireError(f"unknown frame kind {kind}")
     if len(header) > 0xFFFF:
@@ -79,30 +93,42 @@ def pack_frame(kind: int, header: bytes, body: bytes = b"",
     if len(body) > MAX_BODY_BYTES:
         raise WireError(f"body too large ({len(body)} bytes)")
     return PREFIX.pack(MAGIC, VERSION, kind, len(header), len(body),
-                       meta64, meta32) + header + body
+                       meta64, meta32, req_id) + header + body
 
 
-def unpack_prefix(buf: bytes) -> "tuple[int, int, int, int, int]":
-    """Parse one 24-byte frame prefix -> (kind, header_len, body_len,
-    meta64, meta32); raises :class:`WireError` on anything that is not
-    a well-formed, sane frame head."""
-    if len(buf) != PREFIX_SIZE:
-        raise WireError(f"prefix must be {PREFIX_SIZE} bytes, "
-                        f"got {len(buf)}")
-    magic, version, kind, hlen, blen, meta64, meta32 = PREFIX.unpack(buf)
+def unpack_prefix(buf: bytes) -> "tuple[int, int, int, int, int, int]":
+    """Parse one frame prefix -> (kind, header_len, body_len, meta64,
+    meta32, req_id). Accepts the 32-byte v2 prefix AND the legacy
+    24-byte v1 prefix (``req_id`` reads as 0); raises
+    :class:`WireError` on anything that is not a well-formed, sane
+    frame head."""
+    if len(buf) == PREFIX_SIZE:
+        magic, version, kind, hlen, blen, meta64, meta32, req_id = \
+            PREFIX.unpack(buf)
+        if version != VERSION:
+            raise WireError(f"unsupported wire version {version} for a "
+                            f"{PREFIX_SIZE}-byte prefix")
+    elif len(buf) == PREFIX_V1_SIZE:
+        magic, version, kind, hlen, blen, meta64, meta32 = \
+            PREFIX_V1.unpack(buf)
+        req_id = 0
+        if version != 1:
+            raise WireError(f"unsupported wire version {version} for a "
+                            f"{PREFIX_V1_SIZE}-byte prefix")
+    else:
+        raise WireError(f"prefix must be {PREFIX_V1_SIZE} (v1) or "
+                        f"{PREFIX_SIZE} (v2) bytes, got {len(buf)}")
     if magic != MAGIC:
         raise WireError(f"bad magic {magic!r}")
-    if version != VERSION:
-        raise WireError(f"unsupported wire version {version}")
     if kind not in _KINDS:
         raise WireError(f"unknown frame kind {kind}")
     if blen > MAX_BODY_BYTES:
         raise WireError(f"body length {blen} exceeds {MAX_BODY_BYTES}")
-    return kind, hlen, blen, meta64, meta32
+    return kind, hlen, blen, meta64, meta32, req_id
 
 
 def pack_request(obs: Any, mask: Any, deadline_s: "float | None" = None,
-                 stall: int = 0) -> bytes:
+                 stall: int = 0, req_id: int = 0) -> bytes:
     """Client-side helper: one decide request as a single frame."""
     import jax
     obs_b = b"".join(np.ascontiguousarray(l).tobytes()
@@ -112,45 +138,54 @@ def pack_request(obs: Any, mask: Any, deadline_s: "float | None" = None,
     header = descriptor(obs) + b"|" + descriptor(mask)
     meta64 = 0 if deadline_s is None else max(int(deadline_s * 1e6), 1)
     return pack_frame(KIND_REQ, header, obs_b + mask_b,
-                      meta64=meta64, meta32=int(stall))
+                      meta64=meta64, meta32=int(stall), req_id=req_id)
 
 
-def pack_response(action: Any, latency_s: float) -> bytes:
+def pack_response(action: Any, latency_s: float, req_id: int = 0) -> bytes:
     arr = np.ascontiguousarray(action)
     return pack_frame(KIND_RESP, descriptor(arr), arr.tobytes(),
-                      meta64=max(int(latency_s * 1e6), 0))
+                      meta64=max(int(latency_s * 1e6), 0),
+                      req_id=req_id)
 
 
 def pack_error(reason: str, detail: dict,
-               retry_after_s: "float | None" = None) -> bytes:
+               retry_after_s: "float | None" = None,
+               req_id: int = 0) -> bytes:
     meta64 = (0 if retry_after_s is None
               else max(int(retry_after_s * 1e6), 1))
     return pack_frame(KIND_ERR, reason.encode("ascii"),
-                      json.dumps(detail).encode(), meta64=meta64)
+                      json.dumps(detail).encode(), meta64=meta64,
+                      req_id=req_id)
 
 
-def recv_frame(sock: socket.socket) -> "tuple[int, bytes, bytes, int, int]":
+def recv_frame(
+        sock: socket.socket
+) -> "tuple[int, bytes, bytes, int, int, int]":
     """Blocking client-side frame read -> (kind, header, body, meta64,
-    meta32). Raises :class:`ConnectionError` on EOF mid-frame, and
-    ``EOFError`` on a clean EOF at a frame boundary."""
-    def read_exact(n: int) -> bytes:
+    meta32, req_id). Version-sniffing: reads the 24-byte v1 head, then
+    the 8-byte v2 tail iff the version byte says so. Raises
+    :class:`ConnectionError` on EOF mid-frame, and ``EOFError`` on a
+    clean EOF at a frame boundary."""
+    def read_exact(n: int, at_boundary: bool = False) -> bytes:
         chunks = []
         got = 0
         while got < n:
             c = sock.recv(n - got)
             if not c:
-                if got == 0 and not chunks:
+                if at_boundary and got == 0:
                     raise EOFError("connection closed at frame boundary")
                 raise ConnectionError("connection closed mid-frame")
             chunks.append(c)
             got += len(c)
         return b"".join(chunks)
 
-    kind, hlen, blen, meta64, meta32 = unpack_prefix(
-        read_exact(PREFIX_SIZE))
+    head = read_exact(PREFIX_V1_SIZE, at_boundary=True)
+    if len(head) > 4 and head[4] == VERSION:
+        head += read_exact(PREFIX_SIZE - PREFIX_V1_SIZE)
+    kind, hlen, blen, meta64, meta32, req_id = unpack_prefix(head)
     header = read_exact(hlen) if hlen else b""
     body = read_exact(blen) if blen else b""
-    return kind, header, body, meta64, meta32
+    return kind, header, body, meta64, meta32, req_id
 
 
 def unpack_action(header: bytes, body: bytes) -> np.ndarray:

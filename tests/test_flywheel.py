@@ -112,6 +112,58 @@ class TestFlightLog:
         assert "flywheel_rows_logged_total 20" in rendered
         assert "flywheel_shards_sealed_total 3" in rendered
 
+    def test_req_id_column_round_trips(self, tmp_path):
+        d = str(tmp_path)
+        obs, mask, act, lp, val, stall, oc = synth_rows(20)
+        rids = np.arange(1, 21, dtype=np.int64) << 40   # salted-looking
+        with FlightLogWriter(d, capacity=8) as w:
+            for lo, hi in ((0, 7), (7, 14), (14, 20)):
+                w.append_batch(obs[lo:hi], mask[lo:hi], act[lo:hi],
+                               lp[lo:hi], val[lo:hi], stall[lo:hi],
+                               oc[lo:hi], req_id=rids[lo:hi])
+        cat = read_flight_log(d).concat()
+        assert cat.req_id.dtype == np.int64
+        np.testing.assert_array_equal(cat.req_id, rids)
+
+    def test_req_id_defaults_to_unassigned_zero(self, tmp_path):
+        d = str(tmp_path)
+        write_synth_log(d, n=8, capacity=8)    # no req_id passed
+        cat = read_flight_log(d).concat()
+        np.testing.assert_array_equal(cat.req_id, np.zeros(8, np.int64))
+
+    def test_pre_issue20_shard_loads_with_zero_req_ids(self, tmp_path):
+        """A shard written before the req_id column existed must still
+        load (ids read as 0 = unassigned), and concat must not trip on
+        the mixed old/new shard case."""
+        from rlgpuschedule_tpu.flywheel.flightlog import _crc32_file
+        d = str(tmp_path)
+        obs, mask, act, lp, val, stall, oc = synth_rows(16)
+        rids = np.arange(100, 116, dtype=np.int64)
+        with FlightLogWriter(d, capacity=8) as w:
+            w.append_batch(obs, mask, act, lp, val, stall, oc,
+                           req_id=rids)
+        # strip req_id out of shard 0 as if written by the old code,
+        # then re-bless its crc sidecar
+        path = os.path.join(d, shard_name(0))
+        with np.load(path) as z:
+            cols = {k: z[k] for k in z.files if k != "req_id"}
+        with open(path, "wb") as f:
+            np.savez(f, **cols)
+        side = os.path.join(d, ".crc", "shard-000000.json")
+        meta = json.load(open(side))
+        meta["crc32"] = _crc32_file(path)
+        json.dump(meta, open(side, "w"))
+        cat = read_flight_log(d).concat()
+        np.testing.assert_array_equal(
+            cat.req_id, np.concatenate([np.zeros(8, np.int64), rids[8:]]))
+
+    def test_req_id_length_mismatch_rejected(self, tmp_path):
+        obs, mask, act, lp, val, stall, oc = synth_rows(4)
+        with FlightLogWriter(str(tmp_path), capacity=8) as w:
+            with pytest.raises(ValueError, match="req_id"):
+                w.append_batch(obs, mask, act, lp, val, stall, oc,
+                               req_id=np.arange(3, dtype=np.int64))
+
     def test_rows_logged_counts_sealed_plus_buffered(self, tmp_path):
         obs, mask, act, lp, val, stall, oc = synth_rows(5)
         w = FlightLogWriter(str(tmp_path), capacity=4)

@@ -321,7 +321,7 @@ class TestFramedDialect:
                 for i in range(8):
                     o = onehot(i % OBS_D)
                     s.sendall(wire.pack_request(o, mask))
-                    kind, header, body, meta64, _ = wire.recv_frame(s)
+                    kind, header, body, meta64, _, _ = wire.recv_frame(s)
                     assert kind == wire.KIND_RESP
                     action = wire.unpack_action(header, body)
                     assert int(np.ravel(action)[0]) == i % OBS_D
@@ -337,7 +337,7 @@ class TestFramedDialect:
             with socket.create_connection(("127.0.0.1", handle.port),
                                           timeout=30) as s:
                 s.sendall(wire.pack_request(obs, mask))
-                kind, header, body, _, _ = wire.recv_frame(s)
+                kind, header, body, _, _, _ = wire.recv_frame(s)
                 assert kind == wire.KIND_RESP
                 assert int(np.ravel(
                     wire.unpack_action(header, body))[0]) == \
@@ -352,7 +352,7 @@ class TestFramedDialect:
                     wire.KIND_REQ, b"float64:(6,)|bool:(9,)",
                     obs.tobytes() + mask.tobytes())
                 s.sendall(bad)
-                kind, header, body, _, _ = wire.recv_frame(s)
+                kind, header, body, _, _, _ = wire.recv_frame(s)
                 assert kind == wire.KIND_ERR
                 assert header == b"bad-request"
                 assert "descriptor" in json.loads(body)["detail"]
@@ -367,7 +367,7 @@ class TestFramedDialect:
             with socket.create_connection(("127.0.0.1", handle.port),
                                           timeout=30) as s:
                 s.sendall(wire.pack_response(np.int32(0), 0.0))
-                kind, header, _, _, _ = wire.recv_frame(s)
+                kind, header, _, _, _, _ = wire.recv_frame(s)
                 assert kind == wire.KIND_ERR
                 assert header == b"bad-request"
                 with pytest.raises(EOFError):
@@ -382,7 +382,7 @@ class TestFramedDialect:
                 assert wire.recv_frame(s)[0] == wire.KIND_RESP  # learns
                 s.sendall(wire.pack_request(obs, mask,
                                             deadline_s=0.001))
-                kind, header, body, meta64, _ = wire.recv_frame(s)
+                kind, header, body, meta64, _, _ = wire.recv_frame(s)
                 assert kind == wire.KIND_ERR
                 assert header == b"shed:admission"
                 detail = json.loads(body)
@@ -402,11 +402,117 @@ class TestFramedDialect:
                 assert wire.recv_frame(s)[0] == wire.KIND_RESP
                 handle.drain()
                 s.sendall(wire.pack_request(obs, mask))
-                kind, header, _, _, _ = wire.recv_frame(s)
+                kind, header, _, _, _, _ = wire.recv_frame(s)
                 assert kind == wire.KIND_ERR
                 assert header == b"closed"
                 with pytest.raises(EOFError):
                     wire.recv_frame(s)
+
+
+class TestRequestCausality:
+    """ISSUE 20: the 64-bit request id rides every reply shape on both
+    dialects — inbound via ``X-Request-Id`` / the v2 frame field,
+    server-minted when absent, echoed even on sheds."""
+
+    def test_http_keepalive_echoes_inbound_id(self):
+        with serving_stack() as (handle, server, reg, obs, mask):
+            with socket.create_connection(("127.0.0.1", handle.port),
+                                          timeout=30) as s, \
+                    s.makefile("rb") as f:
+                for rid in (1, 0xABC123, (1 << 62) + 5):
+                    s.sendall(raw_request(obs, mask,
+                                          (f"X-Request-Id: {rid}",)))
+                    status, _, payload = read_response(f)
+                    assert status == 200
+                    assert payload["request_id"] == rid
+
+    def test_http_mints_distinct_ids_when_absent(self):
+        with serving_stack() as (handle, server, reg, obs, mask):
+            body = obs.tobytes() + mask.tobytes()
+            ids = set()
+            for _ in range(4):
+                status, _, payload = post(handle.url + DECIDE_PATH, body)
+                assert status == 200
+                ids.add(payload["request_id"])
+            assert len(ids) == 4 and 0 not in ids
+
+    def test_http_bad_request_id_is_400(self):
+        with serving_stack() as (handle, server, reg, obs, mask):
+            body = obs.tobytes() + mask.tobytes()
+            for bad in ("junk", "-3", str(1 << 63)):
+                status, _, payload = post(
+                    handle.url + DECIDE_PATH, body,
+                    headers={"X-Request-Id": bad})
+                assert status == 400, bad
+                assert "X-Request-Id" in payload["detail"]
+
+    def test_http_shed_echoes_id(self):
+        with serving_stack(cost_s=0.05, max_bucket=1) as (
+                handle, server, reg, obs, mask):
+            body = obs.tobytes() + mask.tobytes()
+            assert post(handle.url + DECIDE_PATH, body)[0] == 200
+            status, _, payload = post(
+                handle.url + DECIDE_PATH, body,
+                headers={"X-Deadline-Ms": "1",
+                         "X-Request-Id": "314159"})
+            assert status == 503 and payload["error"] == "shed"
+            assert payload["request_id"] == 314159
+
+    def test_framed_echoes_and_mints(self):
+        with serving_stack() as (handle, server, reg, obs, mask):
+            with socket.create_connection(("127.0.0.1", handle.port),
+                                          timeout=30) as s:
+                s.sendall(wire.pack_request(obs, mask, req_id=0x5150))
+                kind, _, _, _, _, rid = wire.recv_frame(s)
+                assert kind == wire.KIND_RESP and rid == 0x5150
+                # id 0 = unassigned: the server mints one and echoes it
+                s.sendall(wire.pack_request(obs, mask))
+                kind, _, _, _, _, rid = wire.recv_frame(s)
+                assert kind == wire.KIND_RESP and rid > 0
+
+    def test_framed_error_frames_echo_id(self):
+        with serving_stack() as (handle, server, reg, obs, mask):
+            with socket.create_connection(("127.0.0.1", handle.port),
+                                          timeout=30) as s:
+                bad = wire.pack_frame(
+                    wire.KIND_REQ, b"float64:(6,)|bool:(9,)",
+                    obs.tobytes() + mask.tobytes(), req_id=0x77)
+                s.sendall(bad)
+                kind, header, _, _, _, rid = wire.recv_frame(s)
+                assert kind == wire.KIND_ERR
+                assert header == b"bad-request" and rid == 0x77
+
+    def test_framed_v1_frame_still_served(self):
+        """A legacy client's 24-byte v1 frame decodes on the live port:
+        the server mints an id and answers with a v2 response frame."""
+        with serving_stack() as (handle, server, reg, obs, mask):
+            desc = wire.descriptor(obs) + b"|" + wire.descriptor(mask)
+            body = obs.tobytes() + mask.tobytes()
+            v1 = wire.PREFIX_V1.pack(wire.MAGIC, 1, wire.KIND_REQ,
+                                     len(desc), len(body), 0, 0) \
+                + desc + body
+            with socket.create_connection(("127.0.0.1", handle.port),
+                                          timeout=30) as s:
+                s.sendall(v1)
+                kind, header, rbody, _, _, rid = wire.recv_frame(s)
+                assert kind == wire.KIND_RESP and rid > 0
+                assert int(np.ravel(
+                    wire.unpack_action(header, rbody))[0]) == \
+                    int(np.argmax(obs))
+
+    def test_framed_int64_overflow_id_rejected(self):
+        with serving_stack() as (handle, server, reg, obs, mask):
+            with socket.create_connection(("127.0.0.1", handle.port),
+                                          timeout=30) as s:
+                s.sendall(wire.pack_request(obs, mask,
+                                            req_id=(1 << 63) + 1))
+                kind, header, body, _, _, _ = wire.recv_frame(s)
+                assert kind == wire.KIND_ERR
+                assert header == b"bad-request"
+                assert "2**63" in json.loads(body)["detail"]
+                # not terminal: the stream stays framed
+                s.sendall(wire.pack_request(obs, mask))
+                assert wire.recv_frame(s)[0] == wire.KIND_RESP
 
 
 class TestRetryAfterClamp:

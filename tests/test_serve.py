@@ -514,8 +514,8 @@ class TestArenaDataPlane:
         assert stats["blocks"] >= 1
         assert stats["rows"] == stats["blocks"] * 8
         # one counted allocation per slab array: obs leaves + mask
-        # leaves + the stall vector, per block
-        assert stats["slab_allocs"] == stats["blocks"] * 3
+        # leaves + the stall vector + the req-id lane, per block
+        assert stats["slab_allocs"] == stats["blocks"] * 4
         legacy = PolicyServer(ArgmaxEngine(8), data_plane="legacy")
         assert legacy.arena_stats()["blocks"] == 0
         legacy.close()
@@ -537,6 +537,145 @@ class TestArenaDataPlane:
         assert server.service_time_s() is not None   # learned
         engine.listeners[0]()                        # fleet re-warmed
         assert server.service_time_s() is None       # forgotten
+        server.close()
+
+
+class TestRequestCausality:
+    """ISSUE 20 tentpole: the 64-bit request id threads submit ->
+    arena slot -> dispatch -> scatter -> result, and every submitted id
+    resolves exactly once as served, shed, or failed."""
+
+    def test_minted_ids_unique_salted_and_on_results(self):
+        rows = request_rows(8)
+        server = PolicyServer(ArgmaxEngine(8), data_plane="arena",
+                              example_obs=rows[0][0],
+                              example_mask=rows[0][1])
+        futs = [server.submit(o, m) for o, m in rows]
+        assert server.pump() == 8
+        ids = [f.result(timeout=10).req_id for f in futs]
+        assert len(set(ids)) == 8
+        salts = {i >> 40 for i in ids}
+        assert len(salts) == 1                   # same rank+pid salt
+        assert all(0 < i < (1 << 63) for i in ids)   # int64-safe
+        server.close()
+
+    @pytest.mark.parametrize("plane", ["legacy", "arena"])
+    def test_explicit_id_round_trips(self, plane):
+        rows = request_rows(1)
+        server = PolicyServer(ArgmaxEngine(8), data_plane=plane,
+                              example_obs=rows[0][0],
+                              example_mask=rows[0][1])
+        fut = server.submit(*rows[0], req_id=0x123456789ABCDEF)
+        server.pump()
+        assert fut.result(timeout=10).req_id == 0x123456789ABCDEF
+        server.close()
+
+    def test_conservation_every_id_resolves_exactly_once(self, tmp_path):
+        """The property the ci.sh chaos gate asserts at scale: over a
+        run with served, failed, and in-queue-expired requests, the
+        merged instant stream resolves every enqueued id exactly once
+        as served | shed | dispatch_failed."""
+        from rlgpuschedule_tpu.obs import EventBus, Tracer
+        from rlgpuschedule_tpu.obs.events import merge_dir
+
+        class FlakyEngine(ArgmaxEngine):
+            def __init__(self, max_bucket=8):
+                super().__init__(max_bucket)
+                self.dispatches = 0
+
+            def decide(self, obs, mask, stall=None):
+                self.dispatches += 1
+                if self.dispatches == 2:
+                    raise RuntimeError("injected fault")
+                return super().decide(obs, mask, stall)
+
+        bus = EventBus(str(tmp_path), rank=0, name="serve")
+        server = PolicyServer(FlakyEngine(8), data_plane="arena",
+                              example_obs=request_rows(1)[0][0],
+                              example_mask=request_rows(1)[0][1],
+                              tracer=Tracer(bus, enabled=True))
+        rows = request_rows(24)
+        futs = [server.submit(o, m) for o, m in rows[:8]]
+        assert server.pump() == 8                    # dispatch 1: served
+        futs += [server.submit(o, m) for o, m in rows[8:16]]
+        with pytest.raises(RuntimeError):
+            server.pump()                            # dispatch 2: fails
+        for f in futs[8:16]:
+            with pytest.raises(RuntimeError):
+                f.result(timeout=10)
+        # round 3: half shed at admission (deadline below any predicted
+        # wait), half admitted but left to expire in the queue
+        futs += [server.submit(o, m, deadline_s=1e-9)
+                 for o, m in rows[16:20]]
+        futs += [server.submit(o, m, deadline_s=0.01)
+                 for o, m in rows[20:24]]
+        import time as _time
+        _time.sleep(0.05)
+        server.pump()                                # expire the admitted ones
+        from rlgpuschedule_tpu.serve.batching import DeadlineSheddedError
+        for f in futs[16:]:
+            with pytest.raises(DeadlineSheddedError):
+                f.result(timeout=10)
+        server.close()
+        bus.close()
+
+        pts = [e for e in merge_dir(str(tmp_path))
+               if e.get("kind") == "span_point"]
+        enq = [e["attrs"]["req_id"] for e in pts
+               if e.get("span") == "enqueue"]
+        served = [r for e in pts if e.get("span") == "served"
+                  for r in e["attrs"]["req_ids"]]
+        shed = [(e["attrs"]["req_id"], e["attrs"]["reason"])
+                for e in pts if e.get("span") == "shed"]
+        failed = [r for e in pts if e.get("span") == "dispatch_failed"
+                  for r in e["attrs"]["req_ids"]]
+        # the ci.sh gate's ledger: submitted = enqueued + admission-shed
+        # (admission sheds never reach the queue so never emit enqueue);
+        # resolved = served + shed (any reason) + dispatch_failed
+        submitted = enq + [r for r, why in shed if why == "admission"]
+        resolved = served + failed + [r for r, _ in shed]
+        assert len(submitted) == len(set(submitted)) == 24
+        assert sorted(resolved) == sorted(submitted)  # exactly once each
+        assert (len(served), len(failed), len(shed)) == (8, 8, 8)
+        reasons = {why for _, why in shed}
+        assert reasons == {"admission", "expired"}    # both shed paths hit
+
+    def test_shed_exception_and_instant_carry_req_id(self, tmp_path):
+        from rlgpuschedule_tpu.obs import EventBus, Tracer
+        from rlgpuschedule_tpu.obs.events import merge_dir
+        from rlgpuschedule_tpu.serve.batching import DeadlineSheddedError
+        bus = EventBus(str(tmp_path), rank=0, name="serve")
+        rows = request_rows(2)
+        server = PolicyServer(ArgmaxEngine(8), data_plane="arena",
+                              example_obs=rows[0][0],
+                              example_mask=rows[0][1],
+                              tracer=Tracer(bus, enabled=True))
+        fut = server.submit(*rows[0], deadline_s=1e-6, req_id=777)
+        import time as _time
+        _time.sleep(0.005)
+        server.pump()
+        with pytest.raises(DeadlineSheddedError) as ei:
+            fut.result(timeout=10)
+        assert ei.value.req_id == 777
+        server.close()
+        bus.close()
+        sheds = [e for e in merge_dir(str(tmp_path))
+                 if e.get("kind") == "span_point"
+                 and e.get("span") == "shed"]
+        assert [e["attrs"]["req_id"] for e in sheds] == [777]
+
+    def test_p99_exemplar_rides_snapshot(self):
+        rows = request_rows(16)
+        server = PolicyServer(ArgmaxEngine(8), data_plane="arena",
+                              example_obs=rows[0][0],
+                              example_mask=rows[0][1])
+        futs = [server.submit(o, m) for o, m in rows]
+        while server.pump():
+            pass
+        ids = {f.result(timeout=10).req_id for f in futs}
+        snap = server.slo_snapshot()
+        assert snap["latency_p99_exemplar_req_id"] in ids
+        assert "slo" in snap                     # engine status attached
         server.close()
 
 

@@ -1,0 +1,236 @@
+"""SLO burn-rate engine unit tests (ISSUE 20): spec validation, the
+multi-window alert condition (fast-fire AND fast-clear), the rolling
+error-budget gauge's recovery, the histogram-tail SLI, and the registry
+pre-scrape collector hook that keeps every scrape fresh (including the
+broken-collector containment contract)."""
+import numpy as np
+import pytest
+
+from rlgpuschedule_tpu.obs import Registry
+from rlgpuschedule_tpu.obs.slo import (DEFAULT_WINDOWS, SLOEngine,
+                                       SLOSpec, histogram_sli)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+class FakeBus:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **fields):
+        self.events.append(dict(fields, kind=kind))
+
+
+def make_engine(windows=((1.0, 1.0), (3.0, 1.0)), objective=0.9,
+                budget_window_s=None):
+    reg = Registry()
+    clock = FakeClock()
+    bus = FakeBus()
+    eng = SLOEngine(reg, bus=bus, clock=clock)
+    spec = SLOSpec("health", objective=objective, windows=windows,
+                   budget_window_s=budget_window_s)
+    state = {"bad": 0.0, "total": 0.0}
+    eng.watch(spec, lambda: (state["bad"], state["total"]))
+    return reg, clock, bus, eng, state
+
+
+class TestSpec:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="objective"):
+            SLOSpec("x", objective=1.0)
+        with pytest.raises(ValueError, match="objective"):
+            SLOSpec("x", objective=0.0)
+        with pytest.raises(ValueError, match="window"):
+            SLOSpec("x", objective=0.9, windows=())
+        with pytest.raises(ValueError, match="bad window"):
+            SLOSpec("x", objective=0.9, windows=((0.0, 1.0),))
+        with pytest.raises(ValueError, match="budget_window_s"):
+            SLOSpec("x", objective=0.9, budget_window_s=-1.0)
+
+    def test_budget_window_defaults_to_longest(self):
+        spec = SLOSpec("x", objective=0.99)
+        assert spec.windows == DEFAULT_WINDOWS
+        assert spec.budget_window == max(w for w, _ in DEFAULT_WINDOWS)
+        assert SLOSpec("y", objective=0.99,
+                       budget_window_s=7.0).budget_window == 7.0
+
+    def test_duplicate_watch_rejected(self):
+        _, _, _, eng, _ = make_engine()
+        with pytest.raises(ValueError, match="already watched"):
+            eng.watch(SLOSpec("health", objective=0.5), lambda: (0, 0))
+
+
+class TestBurnAndBudget:
+    def test_healthy_traffic_never_alerts(self):
+        reg, clock, bus, eng, state = make_engine()
+        for _ in range(10):
+            clock.tick(0.5)
+            state["total"] += 50
+            eng.collect()
+        st = eng.status()["health"]
+        assert not st["alerting"] and st["alerts_total"] == 0
+        assert st["budget_remaining"] == 1.0
+        assert bus.events == []
+
+    def test_alert_fires_clears_and_budget_recovers(self):
+        reg, clock, bus, eng, state = make_engine()
+        clock.tick(0.5)
+        state["total"] += 50
+        eng.collect()
+        # incident: 40% bad over a 10% budget -> burn 4x on all windows
+        for _ in range(3):
+            clock.tick(0.5)
+            state["total"] += 50
+            state["bad"] += 20
+            eng.collect()
+        st = eng.status()["health"]
+        assert st["alerting"] and st["alerts_total"] == 1
+        assert all(b >= 1.0 for b in st["burn"].values())
+        assert st["budget_remaining"] < 1.0
+        alerts = [e for e in bus.events if e["kind"] == "slo_burn_alert"]
+        assert len(alerts) == 1
+        assert alerts[0]["slo"] == "health"
+        assert set(alerts[0]["burns"]) == {"1s", "3s"}
+        # bleeding stops: the 1s window un-trips within a second...
+        clock.tick(1.0)
+        state["total"] += 100
+        eng.collect()
+        st = eng.status()["health"]
+        assert not st["alerting"]
+        clears = [e for e in bus.events if e["kind"] == "slo_burn_clear"]
+        assert len(clears) == 1
+        # ...and the 3s budget window slides past the incident entirely
+        for _ in range(4):
+            clock.tick(1.0)
+            state["total"] += 100
+            eng.collect()
+        st = eng.status()["health"]
+        assert st["budget_remaining"] == 1.0
+        # edges, not levels: still exactly one alert and one clear
+        assert st["alerts_total"] == 1
+        assert len([e for e in bus.events
+                    if e["kind"] == "slo_burn_alert"]) == 1
+
+    def test_all_windows_must_exceed_threshold(self):
+        # long window poisoned by an old incident, short window clean:
+        # the AND condition holds the alert back (fast-clear property)
+        reg, clock, bus, eng, state = make_engine(
+            windows=((1.0, 1.0), (10.0, 1.0)))
+        clock.tick(0.5)
+        state["total"] += 50
+        eng.collect()                  # pre-incident baseline
+        clock.tick(0.5)
+        state["total"] += 50
+        state["bad"] += 25
+        eng.collect()                  # the incident
+        for _ in range(4):
+            clock.tick(1.0)
+            state["total"] += 10       # light clean traffic
+            eng.collect()
+        st = eng.status()["health"]
+        assert st["burn"]["10s"] >= 1.0     # long window still burning
+        assert st["burn"]["1s"] < 1.0       # short window recovered
+        assert not st["alerting"]
+
+    def test_zero_traffic_window_suppresses_alert(self):
+        _, clock, bus, eng, state = make_engine()
+        clock.tick(0.5)
+        eng.collect()                       # no traffic at all
+        assert not eng.status()["health"]["alerting"]
+        assert bus.events == []
+
+    def test_gauges_render_through_collector_hook(self):
+        reg, clock, bus, eng, state = make_engine()
+        clock.tick(1.0)
+        state["total"] += 10
+        state["bad"] += 5
+        # render() runs the collector -- no manual collect() call here
+        text = reg.render()
+        assert 'slo_burn_rate{slo="health",window="1s"}' in text
+        assert 'slo_error_budget_remaining{slo="health"}' in text
+        assert 'slo_burn_alerts_total{slo="health"}' in text
+
+    def test_close_detaches_collector(self):
+        reg, clock, bus, eng, state = make_engine()
+        eng.close()
+        clock.tick(1.0)
+        state["total"] += 10
+        state["bad"] += 10
+        reg.render()
+        assert not eng.status()["health"]["alerting"]
+        assert eng.status()["health"]["burn"]["1s"] == 0.0
+
+
+class TestHistogramSLI:
+    def test_tail_fraction(self):
+        reg = Registry()
+        hist = reg.histogram("t_seconds", "x",
+                             buckets=(0.1, 0.25, 1.0))
+        sample = histogram_sli(hist, 0.25)
+        for v in (0.05, 0.2, 0.2, 0.5, 2.0):
+            hist.observe(v)
+        bad, total = sample()
+        assert (bad, total) == (2.0, 5.0)
+
+    def test_target_between_bounds_is_conservative(self):
+        reg = Registry()
+        hist = reg.histogram("u_seconds", "x", buckets=(0.1, 1.0))
+        sample = histogram_sli(hist, 0.5)    # snaps down to le=0.1
+        hist.observe(0.3)                    # under target, over 0.1
+        bad, total = sample()
+        assert (bad, total) == (1.0, 1.0)
+
+    def test_target_below_all_buckets_rejected(self):
+        reg = Registry()
+        hist = reg.histogram("v_seconds", "x", buckets=(0.1, 1.0))
+        with pytest.raises(ValueError, match="below the"):
+            histogram_sli(hist, 0.01)
+
+
+class TestCollectorContainment:
+    def test_broken_collector_is_counted_not_fatal(self):
+        reg = Registry()
+
+        def broken():
+            raise RuntimeError("boom")
+
+        reg.add_collector(broken)
+        g = reg.gauge("ok_gauge", "x")
+        g.set(3.0)
+        text = reg.render()                  # must not raise
+        assert "ok_gauge 3" in text
+        assert reg.collector_errors >= 1
+
+    def test_collect_is_reentrancy_guarded(self):
+        reg = Registry()
+        calls = []
+
+        def nested():
+            calls.append(1)
+            reg.collect()                    # must not recurse
+
+        reg.add_collector(nested)
+        reg.collect()
+        assert len(calls) == 1
+
+    def test_add_remove_idempotent(self):
+        reg = Registry()
+        calls = []
+        fn = lambda: calls.append(1)
+        reg.add_collector(fn)
+        reg.add_collector(fn)                # dedup
+        reg.collect()
+        assert len(calls) == 1
+        reg.remove_collector(fn)
+        reg.remove_collector(fn)             # no-op
+        reg.collect()
+        assert len(calls) == 1

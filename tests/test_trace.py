@@ -395,7 +395,15 @@ class TestServeObservability:
         bus.close()
         events = read_events(bus.path)
         points = [e["span"] for e in events if e["kind"] == SPAN_POINT]
-        assert points == ["enqueue", "enqueue"]
+        assert points == ["enqueue", "enqueue", "served"]
+        # every enqueue carries a minted request id, and the served
+        # instant resolves exactly those ids (conservation)
+        enq_ids = [e["attrs"]["req_id"] for e in events
+                   if e["kind"] == SPAN_POINT and e["span"] == "enqueue"]
+        served = [e for e in events
+                  if e["kind"] == SPAN_POINT and e["span"] == "served"]
+        assert all(i > 0 for i in enq_ids)
+        assert sorted(served[0]["attrs"]["req_ids"]) == sorted(enq_ids)
         begins = [e["span"] for e in events if e["kind"] == SPAN_BEGIN]
         assert begins == ["serve_batch", "arena_seal", "scatter"]
         # arena_seal/scatter nest INSIDE serve_batch

@@ -1,6 +1,8 @@
-"""Wire-framing unit tests (ISSUE 17): the length-prefixed binary
-protocol is small enough to pin completely — prefix round-trip, the
-descriptor grammar, every rejection path of :func:`unpack_prefix`, the
+"""Wire-framing unit tests (ISSUE 17, v2 in ISSUE 20): the
+length-prefixed binary protocol is small enough to pin completely —
+prefix round-trip (32-byte v2 with the ``req_id`` causality field, and
+backward decode of legacy 24-byte v1 frames), the descriptor grammar,
+every rejection path of :func:`unpack_prefix`, the
 request/response/error pack helpers, and the blocking client reader's
 EOF semantics (clean boundary EOF vs mid-frame truncation)."""
 import socket
@@ -19,24 +21,33 @@ def example():
 
 
 class TestPrefix:
-    def test_prefix_is_24_bytes(self):
-        assert wire.PREFIX_SIZE == 24
+    def test_prefix_sizes(self):
+        assert wire.PREFIX_SIZE == 32
+        assert wire.PREFIX_V1_SIZE == 24
+        assert wire.VERSION == 2
 
     def test_pack_unpack_round_trip(self):
         frame = wire.pack_frame(wire.KIND_REQ, b"hdr", b"body",
-                                meta64=123456, meta32=7)
-        kind, hlen, blen, meta64, meta32 = wire.unpack_prefix(
+                                meta64=123456, meta32=7,
+                                req_id=0xDEADBEEF)
+        kind, hlen, blen, meta64, meta32, req_id = wire.unpack_prefix(
             frame[:wire.PREFIX_SIZE])
-        assert (kind, hlen, blen, meta64, meta32) == \
-            (wire.KIND_REQ, 3, 4, 123456, 7)
+        assert (kind, hlen, blen, meta64, meta32, req_id) == \
+            (wire.KIND_REQ, 3, 4, 123456, 7, 0xDEADBEEF)
         assert frame[wire.PREFIX_SIZE:wire.PREFIX_SIZE + hlen] == b"hdr"
         assert frame[wire.PREFIX_SIZE + hlen:] == b"body"
+
+    def test_req_id_defaults_to_zero(self):
+        frame = wire.pack_frame(wire.KIND_REQ, b"", b"")
+        assert wire.unpack_prefix(frame[:wire.PREFIX_SIZE])[5] == 0
 
     @pytest.mark.parametrize("mutate,msg", [
         (lambda b: b"XXXX" + b[4:], "bad magic"),
         (lambda b: b[:4] + bytes([99]) + b[5:], "wire version"),
         (lambda b: b[:5] + bytes([0]) + b[6:], "frame kind"),
-        (lambda b: b[:-1], "must be 24 bytes"),
+        (lambda b: b[:-1], r"must be 24 \(v1\) or 32 \(v2\) bytes"),
+        # a 24-byte prefix claiming v2 is a torn v2 prefix, not a v1 one
+        (lambda b: b[:24], "wire version"),
     ])
     def test_unpack_prefix_rejects_malformed(self, mutate, msg):
         good = wire.pack_frame(wire.KIND_REQ, b"", b"")
@@ -45,7 +56,7 @@ class TestPrefix:
 
     def test_unpack_prefix_rejects_oversized_body(self):
         raw = wire.PREFIX.pack(wire.MAGIC, wire.VERSION, wire.KIND_REQ,
-                               0, wire.MAX_BODY_BYTES + 1, 0, 0)
+                               0, wire.MAX_BODY_BYTES + 1, 0, 0, 0)
         with pytest.raises(wire.WireError, match="exceeds"):
             wire.unpack_prefix(raw)
 
@@ -54,6 +65,44 @@ class TestPrefix:
             wire.pack_frame(0, b"")
         with pytest.raises(wire.WireError, match="header too large"):
             wire.pack_frame(wire.KIND_REQ, b"x" * 0x10000)
+
+
+class TestV1Backward:
+    """A v2 server must keep decoding the 24-byte v1 frames every
+    pre-ISSUE-20 client still sends — ``req_id`` reads as 0 (the
+    "unassigned" sentinel the server mints over)."""
+
+    @staticmethod
+    def v1_frame(kind, header=b"", body=b"", meta64=0, meta32=0):
+        return wire.PREFIX_V1.pack(wire.MAGIC, 1, kind, len(header),
+                                   len(body), meta64, meta32) \
+            + header + body
+
+    def test_v1_prefix_decodes_with_zero_req_id(self):
+        raw = self.v1_frame(wire.KIND_REQ, b"hdr", b"body!",
+                            meta64=250_000, meta32=3)
+        out = wire.unpack_prefix(raw[:wire.PREFIX_V1_SIZE])
+        assert out == (wire.KIND_REQ, 3, 5, 250_000, 3, 0)
+
+    def test_v1_prefix_rejections_still_fire(self):
+        raw = self.v1_frame(wire.KIND_REQ)[:wire.PREFIX_V1_SIZE]
+        with pytest.raises(wire.WireError, match="bad magic"):
+            wire.unpack_prefix(b"XXXX" + raw[4:])
+        with pytest.raises(wire.WireError, match="frame kind"):
+            wire.unpack_prefix(raw[:5] + bytes([0]) + raw[6:])
+
+    def test_recv_frame_reads_v1_stream(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(self.v1_frame(wire.KIND_REQ, b"h", b"xyz",
+                                    meta32=7))
+            kind, header, body, meta64, meta32, req_id = \
+                wire.recv_frame(b)
+            assert (kind, header, body) == (wire.KIND_REQ, b"h", b"xyz")
+            assert (meta64, meta32, req_id) == (0, 7, 0)
+        finally:
+            a.close()
+            b.close()
 
 
 class TestDescriptor:
@@ -72,26 +121,32 @@ class TestDescriptor:
 
 
 class TestPackHelpers:
-    def test_pack_request_carries_deadline_and_stall(self):
+    def test_pack_request_carries_deadline_stall_and_req_id(self):
         obs, mask = example()
-        frame = wire.pack_request(obs, mask, deadline_s=0.25, stall=3)
-        kind, hlen, blen, meta64, meta32 = wire.unpack_prefix(
+        frame = wire.pack_request(obs, mask, deadline_s=0.25, stall=3,
+                                  req_id=0x68C90000000001)
+        kind, hlen, blen, meta64, meta32, req_id = wire.unpack_prefix(
             frame[:wire.PREFIX_SIZE])
         assert kind == wire.KIND_REQ
         assert meta64 == 250_000 and meta32 == 3
+        assert req_id == 0x68C90000000001
         assert blen == obs.nbytes + mask.nbytes
         header = frame[wire.PREFIX_SIZE:wire.PREFIX_SIZE + hlen]
         assert header == wire.descriptor(obs) + b"|" + wire.descriptor(mask)
-        # no deadline -> meta64 == 0 (the "no SLO" sentinel)
+        # no deadline -> meta64 == 0 (the "no SLO" sentinel); no id ->
+        # req_id == 0 (the server mints one)
         frame = wire.pack_request(obs, mask)
-        assert wire.unpack_prefix(frame[:wire.PREFIX_SIZE])[3] == 0
+        out = wire.unpack_prefix(frame[:wire.PREFIX_SIZE])
+        assert out[3] == 0 and out[5] == 0
 
     def test_pack_response_action_round_trip(self):
         action = np.arange(5, dtype=np.int32)
-        frame = wire.pack_response(action, latency_s=0.002)
-        kind, hlen, blen, meta64, _ = wire.unpack_prefix(
+        frame = wire.pack_response(action, latency_s=0.002,
+                                   req_id=0xBEEF)
+        kind, hlen, blen, meta64, _, req_id = wire.unpack_prefix(
             frame[:wire.PREFIX_SIZE])
         assert kind == wire.KIND_RESP and meta64 == 2000
+        assert req_id == 0xBEEF
         header = frame[wire.PREFIX_SIZE:wire.PREFIX_SIZE + hlen]
         body = frame[wire.PREFIX_SIZE + hlen:]
         out = wire.unpack_action(header, body)
@@ -104,10 +159,11 @@ class TestPackHelpers:
 
     def test_pack_error_retry_after_microseconds(self):
         frame = wire.pack_error("shed:admission", {"x": 1},
-                                retry_after_s=0.05)
-        kind, hlen, _, meta64, _ = wire.unpack_prefix(
+                                retry_after_s=0.05, req_id=42)
+        kind, hlen, _, meta64, _, req_id = wire.unpack_prefix(
             frame[:wire.PREFIX_SIZE])
         assert kind == wire.KIND_ERR and meta64 == 50_000
+        assert req_id == 42
         assert frame[wire.PREFIX_SIZE:wire.PREFIX_SIZE + hlen] == \
             b"shed:admission"
         # retry omitted -> 0 = "do not retry here"
@@ -124,7 +180,7 @@ class TestRecvFrame:
         a, b = self._pipe()
         try:
             obs, mask = example()
-            frame = wire.pack_request(obs, mask)
+            frame = wire.pack_request(obs, mask, req_id=0x123456789AB)
 
             def dribble():
                 for i in range(0, len(frame), 7):
@@ -132,9 +188,10 @@ class TestRecvFrame:
 
             t = threading.Thread(target=dribble)
             t.start()
-            kind, header, body, _, _ = wire.recv_frame(b)
+            kind, header, body, _, _, req_id = wire.recv_frame(b)
             t.join()
             assert kind == wire.KIND_REQ
+            assert req_id == 0x123456789AB
             assert body == obs.tobytes() + mask.tobytes()
             assert header == (wire.descriptor(obs) + b"|"
                               + wire.descriptor(mask))
@@ -159,9 +216,21 @@ class TestRecvFrame:
             wire.recv_frame(b)
         b.close()
 
+    def test_truncated_v2_tail_is_connection_error(self):
+        # the 24-byte head of a v2 frame arrives, the 8-byte req_id
+        # tail never does: mid-frame death, not a clean boundary
+        obs, mask = example()
+        frame = wire.pack_request(obs, mask)
+        a, b = self._pipe()
+        a.sendall(frame[:wire.PREFIX_V1_SIZE])
+        a.close()
+        with pytest.raises(ConnectionError):
+            wire.recv_frame(b)
+        b.close()
+
 
 class TestGoldenBytes:
-    """The exact 24-byte frame prefix, pinned as a literal.
+    """The exact 32-byte v2 frame prefix, pinned as a literal.
 
     This is the protocol's change detector: if an edit to
     ``serve/wire.py`` flips any of these bytes, old clients and new
@@ -170,11 +239,24 @@ class TestGoldenBytes:
     cross-validates this literal against the wire module's ``MAGIC``/
     ``VERSION``/``struct`` constants (and fires on the wire module if
     the pin is ever deleted), so the two can only change together.
+    ``V1_PREFIX_PIN`` keeps the RETIRED 24-byte v1 layout decodable
+    forever (backward-compat contract, not the live protocol pin).
     """
 
     # PREFIX.pack(MAGIC, VERSION, KIND_REQ, hlen=4, blen=10,
-    #             meta64=0x1122334455667788, meta32=0x99AABBCC)
+    #             meta64=0x1122334455667788, meta32=0x99AABBCC,
+    #             req_id=0x0F1E2D3C4B5A6978)
     GOLDEN_PREFIX = (b"RLSF"                              # magic
+                     b"\x02"                              # version
+                     b"\x01"                              # kind=REQ
+                     b"\x04\x00"                          # hlen=4 LE
+                     b"\x0a\x00\x00\x00"                  # blen=10 LE
+                     b"\x88\x77\x66\x55\x44\x33\x22\x11"  # meta64 LE
+                     b"\xcc\xbb\xaa\x99"                  # meta32 LE
+                     b"\x78\x69\x5a\x4b\x3c\x2d\x1e\x0f") # req_id LE
+
+    # the frozen v1 layout (no req_id field): decode-only since v2
+    V1_PREFIX_PIN = (b"RLSF"                              # magic
                      b"\x01"                              # version
                      b"\x01"                              # kind=REQ
                      b"\x04\x00"                          # hlen=4 LE
@@ -185,15 +267,27 @@ class TestGoldenBytes:
     def test_packed_prefix_matches_golden_bytes(self):
         frame = wire.pack_frame(wire.KIND_REQ, b"hdr!", b"body-bytes",
                                 meta64=0x1122334455667788,
-                                meta32=0x99AABBCC)
-        assert len(self.GOLDEN_PREFIX) == wire.PREFIX_SIZE == 24
+                                meta32=0x99AABBCC,
+                                req_id=0x0F1E2D3C4B5A6978)
+        assert len(self.GOLDEN_PREFIX) == wire.PREFIX_SIZE == 32
         assert frame[:wire.PREFIX_SIZE] == self.GOLDEN_PREFIX
         assert frame[wire.PREFIX_SIZE:] == b"hdr!" + b"body-bytes"
 
     def test_golden_bytes_parse_back_exactly(self):
-        kind, hlen, blen, meta64, meta32 = wire.unpack_prefix(
+        kind, hlen, blen, meta64, meta32, req_id = wire.unpack_prefix(
             self.GOLDEN_PREFIX)
         assert kind == wire.KIND_REQ
         assert (hlen, blen) == (4, 10)
         assert meta64 == 0x1122334455667788
         assert meta32 == 0x99AABBCC
+        assert req_id == 0x0F1E2D3C4B5A6978
+
+    def test_v1_pin_parses_back_exactly(self):
+        assert len(self.V1_PREFIX_PIN) == wire.PREFIX_V1_SIZE == 24
+        kind, hlen, blen, meta64, meta32, req_id = wire.unpack_prefix(
+            self.V1_PREFIX_PIN)
+        assert kind == wire.KIND_REQ
+        assert (hlen, blen) == (4, 10)
+        assert meta64 == 0x1122334455667788
+        assert meta32 == 0x99AABBCC
+        assert req_id == 0
